@@ -1,0 +1,26 @@
+"""hotstuff_tpu — a TPU-native 2-chain HotStuff BFT framework.
+
+A ground-up re-design of the capabilities of the reference Rust implementation
+(asonnino/hotstuff, mounted read-only at /root/reference): a committee of
+``N = 3f+1`` validators receives client transactions, batches them in a
+mempool, and totally orders batch digests via 2-chain HotStuff consensus.
+
+Architecture (TPU-first, not a port):
+
+- **Protocol plane** (host): asyncio actor runtime — every component owns its
+  state in a single task and communicates over bounded queues / TCP, mirroring
+  the reference's tokio actor topology (reference ``node/src/node.rs:18-70``).
+- **Crypto plane** (device): the hot path — SHA-512 digests and Ed25519
+  quorum-certificate batch verification (reference ``crypto/src/lib.rs:206-219``,
+  ``consensus/src/messages.rs:180-198``) — is a pluggable backend where
+  ``backend=tpu`` routes to JAX kernels: GF(2^255-19) limb arithmetic on the
+  VPU, shared-doubling multi-scalar multiplication for random-linear-combination
+  batch verification, sharded across a ``jax.sharding.Mesh`` with the partial
+  accumulators combined over ICI.
+
+Layers (bottom-up, same decomposition as the reference workspace):
+``crypto`` / ``ops`` (device kernels) / ``store`` / ``network`` / ``mempool`` /
+``consensus`` / ``node``, plus the Python benchmark harness in ``benchmark/``.
+"""
+
+__version__ = "0.1.0"

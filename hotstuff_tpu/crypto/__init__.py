@@ -1,0 +1,363 @@
+"""Crypto layer: digests, Ed25519 keys/signatures, signature service.
+
+Same public surface as the reference crypto crate (``crypto/src/lib.rs:20-250``):
+``Digest``, ``PublicKey``, ``SecretKey``, ``generate_keypair``, ``Signature``
+(with ``new``/``verify``/``verify_batch``) and ``SignatureService``. All
+protocol digests are SHA-512 truncated to 32 bytes and signatures sign the
+32-byte digest, never the raw message (reference ``crypto/src/lib.rs:185``,
+``consensus/src/messages.rs:79-90``).
+
+Batch verification is a pluggable backend: ``cpu`` (OpenSSL per-signature
+loop) or ``tpu`` (JAX random-linear-combination MSM on device) — selected via
+``set_backend()`` or the ``HOTSTUFF_CRYPTO_BACKEND`` env var. This is the
+north-star offload site: QC verification calls ``Signature.verify_batch`` with
+the 2f+1 vote signatures of a quorum certificate.
+"""
+
+from __future__ import annotations
+
+import base64
+import hashlib
+import os
+import secrets
+
+from cryptography.exceptions import InvalidSignature
+from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+    Ed25519PrivateKey,
+    Ed25519PublicKey,
+)
+
+from . import ed25519_ref
+
+
+class CryptoError(Exception):
+    """Signature or encoding verification failure."""
+
+
+class Digest:
+    """32-byte hash value; base64 display (reference ``crypto/src/lib.rs:20-62``)."""
+
+    SIZE = 32
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) != self.SIZE:
+            raise ValueError(f"digest must be {self.SIZE} bytes, got {len(data)}")
+        self.data = bytes(data)
+
+    @classmethod
+    def default(cls) -> "Digest":
+        return cls(bytes(cls.SIZE))
+
+    def __bytes__(self) -> bytes:
+        return self.data
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Digest) and self.data == other.data
+
+    def __lt__(self, other: "Digest") -> bool:
+        return self.data < other.data
+
+    def __hash__(self) -> int:
+        return hash(self.data)
+
+    def __repr__(self) -> str:
+        return base64.standard_b64encode(self.data).decode()[:16]
+
+    def __str__(self) -> str:
+        return base64.standard_b64encode(self.data).decode()
+
+
+def sha512_digest(*chunks: bytes) -> Digest:
+    """SHA-512 truncated to 32 bytes over the concatenated chunks.
+
+    The protocol-wide hash (reference uses ``ed25519_dalek::Sha512`` the same
+    way, e.g. ``mempool/src/processor.rs:30``).
+    """
+    h = hashlib.sha512()
+    for c in chunks:
+        h.update(c)
+    return Digest(h.digest()[:32])
+
+
+class PublicKey:
+    """Compressed Edwards point, 32 bytes; base64 serde; ordered (for
+    round-robin leader election over sorted keys, reference
+    ``consensus/src/leader.rs:16-20``)."""
+
+    SIZE = 32
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) != self.SIZE:
+            raise ValueError("public key must be 32 bytes")
+        self.data = bytes(data)
+
+    @classmethod
+    def decode_base64(cls, s: str) -> "PublicKey":
+        return cls(base64.standard_b64decode(s))
+
+    def encode_base64(self) -> str:
+        return base64.standard_b64encode(self.data).decode()
+
+    def __bytes__(self) -> bytes:
+        return self.data
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, PublicKey) and self.data == other.data
+
+    def __lt__(self, other: "PublicKey") -> bool:
+        return self.data < other.data
+
+    def __hash__(self) -> int:
+        return hash(self.data)
+
+    def __repr__(self) -> str:
+        return self.encode_base64()[:16]
+
+    def __str__(self) -> str:
+        return self.encode_base64()
+
+
+class SecretKey:
+    """Ed25519 seed (32 bytes). The reference stores the 64-byte expanded
+    keypair (``crypto/src/lib.rs:64-175``) and zeroizes on drop; we keep the
+    seed, from which the expanded key is derived on demand."""
+
+    SIZE = 32
+    __slots__ = ("seed",)
+
+    def __init__(self, seed: bytes) -> None:
+        if len(seed) != self.SIZE:
+            raise ValueError("secret key seed must be 32 bytes")
+        self.seed = bytes(seed)
+
+    @classmethod
+    def decode_base64(cls, s: str) -> "SecretKey":
+        return cls(base64.standard_b64decode(s))
+
+    def encode_base64(self) -> str:
+        return base64.standard_b64encode(self.seed).decode()
+
+    def public_key(self) -> PublicKey:
+        sk = Ed25519PrivateKey.from_private_bytes(self.seed)
+        return PublicKey(sk.public_key().public_bytes_raw())
+
+
+def generate_keypair(rng: "secrets.SystemRandom | None" = None, *, seed: bytes | None = None):
+    """Generate an Ed25519 keypair. ``seed`` pins determinism for tests,
+    mirroring the reference's seeded-RNG fixtures
+    (``consensus/src/tests/common.rs:17-20``)."""
+    if seed is None:
+        if rng is not None:
+            seed = rng.randbytes(32)
+        else:
+            seed = secrets.token_bytes(32)
+    sk = SecretKey(seed)
+    return sk.public_key(), sk
+
+
+class Signature:
+    """Detached Ed25519 signature (64 bytes, R || s).
+
+    The reference splits it into two 32-byte halves for serde
+    (``crypto/src/lib.rs:177-220``); we keep the canonical 64 bytes.
+    """
+
+    SIZE = 64
+    __slots__ = ("data",)
+
+    def __init__(self, data: bytes) -> None:
+        if len(data) != self.SIZE:
+            raise ValueError("signature must be 64 bytes")
+        self.data = bytes(data)
+
+    @classmethod
+    def default(cls) -> "Signature":
+        return cls(bytes(cls.SIZE))
+
+    @classmethod
+    def new(cls, digest: Digest, secret: SecretKey) -> "Signature":
+        """Sign a 32-byte digest (reference ``Signature::new``, ``:185``)."""
+        sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
+        return cls(sk.sign(digest.data))
+
+    def __bytes__(self) -> bytes:
+        return self.data
+
+    def __eq__(self, other) -> bool:
+        return isinstance(other, Signature) and self.data == other.data
+
+    def __hash__(self) -> int:
+        return hash(self.data)
+
+    def part1(self) -> bytes:
+        return self.data[:32]
+
+    def part2(self) -> bytes:
+        return self.data[32:]
+
+    def verify(self, digest: Digest, public_key: PublicKey) -> None:
+        """Strict single verification (reference ``verify`` → dalek
+        ``verify_strict``, ``crypto/src/lib.rs:200-204``). Raises CryptoError."""
+        # OpenSSL's verify is cofactorless (sB == R + hA) and rejects
+        # non-canonical s, matching verify_strict's equation; additionally
+        # reject small-order R/A like dalek does.
+        try:
+            Ed25519PublicKey.from_public_bytes(public_key.data).verify(
+                self.data, digest.data
+            )
+        except (InvalidSignature, ValueError) as e:
+            raise CryptoError(f"invalid signature: {e}") from e
+        if not _strict_point_checks(public_key.data, self.data):
+            raise CryptoError("small-order or non-canonical point in signature")
+
+    @staticmethod
+    def verify_batch(digest: Digest, votes) -> None:
+        """Verify many signatures over the SAME digest — the QC path
+        (reference ``verify_batch``, ``crypto/src/lib.rs:206-219``, called from
+        ``QC::verify``, ``consensus/src/messages.rs:197``).
+
+        ``votes``: iterable of ``(PublicKey, Signature)``. Raises CryptoError
+        if any signature is invalid. Routed to the active backend.
+        """
+        votes = list(votes)
+        get_backend().verify_batch(
+            [digest.data] * len(votes),
+            [pk.data for pk, _ in votes],
+            [sig.data for _, sig in votes],
+        )
+
+    @staticmethod
+    def verify_batch_multi(items) -> None:
+        """General batch verification over per-item digests — used for
+        TC verification (per-voter digests, reference
+        ``consensus/src/messages.rs:303-314``) and for cross-round
+        super-batching on device. ``items``: iterable of
+        ``(Digest, PublicKey, Signature)``."""
+        items = list(items)
+        get_backend().verify_batch(
+            [d.data for d, _, _ in items],
+            [pk.data for _, pk, _ in items],
+            [sig.data for _, _, sig in items],
+        )
+
+
+def _small_order_encodings() -> frozenset[bytes]:
+    """Canonical encodings of the eight 8-torsion points, computed once."""
+    t = ed25519_ref.torsion_generator()
+    encs = set()
+    acc = ed25519_ref.IDENTITY
+    for _ in range(8):
+        encs.add(ed25519_ref.point_compress(acc))
+        acc = ed25519_ref.point_add(acc, t)
+    return frozenset(encs)
+
+
+_SMALL_ORDER = _small_order_encodings()
+_P = ed25519_ref.P
+
+
+def _canonical_y(enc: bytes) -> bool:
+    return (int.from_bytes(enc, "little") & ((1 << 255) - 1)) < _P
+
+
+def _strict_point_checks(pub: bytes, sig: bytes) -> bool:
+    """Reject non-canonical or small-order A/R (dalek verify_strict
+    semantics) using only integer compares against a precomputed table —
+    no field arithmetic on the per-vote hot path."""
+    r_enc = sig[:32]
+    if not (_canonical_y(pub) and _canonical_y(r_enc)):
+        return False
+    # OpenSSL verification already proved both decode to on-curve points, so
+    # a canonical encoding outside the 8-torsion table is not small-order.
+    return pub not in _SMALL_ORDER and r_enc not in _SMALL_ORDER
+
+
+# ---------------------------------------------------------------------------
+# Pluggable batch-verification backend.
+# ---------------------------------------------------------------------------
+
+
+class CpuBackend:
+    """CPU batch verification — the baseline the TPU backend is benchmarked
+    against (stand-in for ed25519-dalek's CPU ``verify_batch``).
+
+    Acceptance semantics are COFACTORED (8sB == 8R + 8hA), identical to the
+    TPU backend and to dalek's batch verifier, so a committee may mix
+    backends without splitting on QC validity. Implementation: fast OpenSSL
+    cofactorless per-signature verification (a strict subset of the
+    cofactored set) with a slow cofactored re-check only for signatures
+    OpenSSL rejects — honest inputs never hit the slow path.
+    """
+
+    name = "cpu"
+
+    def verify_batch(self, msgs, pubs, sigs) -> None:
+        if not len(msgs) == len(pubs) == len(sigs):
+            raise CryptoError("batch length mismatch")
+        for msg, pub, sig in zip(msgs, pubs, sigs):
+            try:
+                Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
+            except (InvalidSignature, ValueError):
+                if not ed25519_ref.verify(pub, msg, sig, strict=False):
+                    raise CryptoError("invalid signature in batch") from None
+
+
+_BACKEND = None
+
+
+def get_backend():
+    global _BACKEND
+    if _BACKEND is None:
+        set_backend(os.environ.get("HOTSTUFF_CRYPTO_BACKEND", "cpu"))
+    return _BACKEND
+
+
+def set_backend(name_or_backend) -> None:
+    """Select the batch-verify backend: "cpu", "tpu", or a backend object."""
+    global _BACKEND
+    if not isinstance(name_or_backend, str):
+        _BACKEND = name_or_backend
+        return
+    name = name_or_backend
+    if name == "cpu":
+        _BACKEND = CpuBackend()
+    elif name == "tpu":
+        # Imported lazily: pulls in jax.
+        from .tpu_backend import TpuBackend
+
+        _BACKEND = TpuBackend()
+    else:
+        raise ValueError(f"unknown crypto backend {name!r}")
+
+
+class SignatureService:
+    """Holds the secret key and signs digests on request.
+
+    The reference runs this as an actor answering mpsc requests with oneshot
+    replies (``crypto/src/lib.rs:222-250``) so signing never blocks protocol
+    tasks. OpenSSL signing is ~15µs, so we sign inline in the awaiting task;
+    the async API is preserved so callers are identical.
+    """
+
+    def __init__(self, secret: SecretKey) -> None:
+        self._sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
+
+    async def request_signature(self, digest: Digest) -> Signature:
+        return Signature(self._sk.sign(digest.data))
+
+
+__all__ = [
+    "CryptoError",
+    "Digest",
+    "sha512_digest",
+    "PublicKey",
+    "SecretKey",
+    "generate_keypair",
+    "Signature",
+    "SignatureService",
+    "get_backend",
+    "set_backend",
+    "CpuBackend",
+]

@@ -1,0 +1,3 @@
+from .serde import Encoder, Decoder, SerdeError
+
+__all__ = ["Encoder", "Decoder", "SerdeError"]

@@ -1,0 +1,50 @@
+"""Shared test fixtures, modeled on the reference's per-crate
+``src/tests/common.rs`` (deterministic keys from a seeded RNG, localhost
+committees with per-test base ports, one-shot ACKing listener doubles —
+reference ``consensus/src/tests/common.rs:17-46,182-198``)."""
+
+from __future__ import annotations
+
+import asyncio
+import random
+import struct
+
+from hotstuff_tpu.crypto import PublicKey, SecretKey, generate_keypair
+
+
+def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:
+    """n deterministic keypairs (seeded RNG, like StdRng::from_seed([0;32]))."""
+    rng = random.Random(0)
+    return [generate_keypair(seed=rng.randbytes(32))[0:2] for _ in range(n)]
+
+
+async def listener(port: int, expected: bytes | None = None, reply: bytes = b"Ack"):
+    """One-shot TCP server: accept, read one length-delimited frame, reply
+    ``Ack``, optionally assert the payload. Returns the received frame.
+
+    The key network test double (reference ``consensus/src/tests/common.rs:182-198``).
+    """
+    received: asyncio.Future[bytes] = asyncio.get_running_loop().create_future()
+
+    async def handle(reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        try:
+            hdr = await reader.readexactly(4)
+            (n,) = struct.unpack(">I", hdr)
+            payload = await reader.readexactly(n)
+            writer.write(struct.pack(">I", len(reply)) + reply)
+            await writer.drain()
+            if not received.done():
+                received.set_result(payload)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            if not received.done():
+                received.set_exception(ConnectionError("listener connection died"))
+
+    server = await asyncio.start_server(handle, "127.0.0.1", port)
+    try:
+        payload = await asyncio.wait_for(received, timeout=10)
+    finally:
+        server.close()
+        await server.wait_closed()
+    if expected is not None:
+        assert payload == expected, f"listener got unexpected payload"
+    return payload

@@ -1,0 +1,13 @@
+"""Test configuration.
+
+Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported
+anywhere, so multi-chip sharding paths (mesh MSM, dryrun_multichip) are
+exercised without TPU hardware. Bench runs use the real chip instead.
+"""
+
+import os
+
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()

@@ -1,0 +1,208 @@
+"""Crypto layer tests — coverage modeled on the reference
+``crypto/src/tests/crypto_tests.rs:31-132`` (key roundtrip, valid/invalid
+single + batch verify, SignatureService), plus oracle cross-checks between
+the pure-Python RFC 8032 implementation and the OpenSSL production path."""
+
+import asyncio
+import random
+
+import pytest
+
+from hotstuff_tpu.crypto import (
+    CpuBackend,
+    CryptoError,
+    Digest,
+    PublicKey,
+    SecretKey,
+    Signature,
+    SignatureService,
+    generate_keypair,
+    set_backend,
+    sha512_digest,
+)
+from hotstuff_tpu.crypto import ed25519_ref as ref
+
+from .common import keys
+
+
+@pytest.fixture(autouse=True)
+def cpu_backend():
+    set_backend("cpu")
+
+
+def test_digest_basics():
+    d = sha512_digest(b"hello")
+    assert len(d.data) == 32
+    assert d == sha512_digest(b"hello")
+    assert d != sha512_digest(b"world")
+    assert Digest.default().data == bytes(32)
+    with pytest.raises(ValueError):
+        Digest(b"short")
+
+
+def test_import_export_public_key():
+    pk, _ = keys(1)[0]
+    assert PublicKey.decode_base64(pk.encode_base64()) == pk
+
+
+def test_import_export_secret_key():
+    _, sk = keys(1)[0]
+    assert SecretKey.decode_base64(sk.encode_base64()).seed == sk.seed
+
+
+def test_keys_deterministic_and_distinct():
+    k1, k2 = keys(4), keys(4)
+    assert [pk.data for pk, _ in k1] == [pk.data for pk, _ in k2]
+    assert len({pk.data for pk, _ in k1}) == 4
+
+
+def test_verify_valid_signature():
+    pk, sk = keys(1)[0]
+    d = sha512_digest(b"payload")
+    sig = Signature.new(d, sk)
+    sig.verify(d, pk)  # must not raise
+
+
+def test_verify_invalid_signature():
+    pk, sk = keys(1)[0]
+    d = sha512_digest(b"payload")
+    sig = Signature.new(d, sk)
+    with pytest.raises(CryptoError):
+        sig.verify(sha512_digest(b"other"), pk)
+    bad = Signature(bytes(64))
+    with pytest.raises(CryptoError):
+        bad.verify(d, pk)
+
+
+def test_verify_wrong_key():
+    (pk0, sk0), (pk1, _) = keys(2)[:2]
+    d = sha512_digest(b"payload")
+    sig = Signature.new(d, sk0)
+    with pytest.raises(CryptoError):
+        sig.verify(d, pk1)
+
+
+def test_verify_batch_valid():
+    d = sha512_digest(b"quorum")
+    votes = [(pk, Signature.new(d, sk)) for pk, sk in keys(4)]
+    Signature.verify_batch(d, votes)  # must not raise
+
+
+def test_verify_batch_one_invalid():
+    d = sha512_digest(b"quorum")
+    votes = [(pk, Signature.new(d, sk)) for pk, sk in keys(4)]
+    other = sha512_digest(b"not-quorum")
+    pk, sk = keys(4)[3]
+    votes[3] = (pk, Signature.new(other, sk))
+    with pytest.raises(CryptoError):
+        Signature.verify_batch(d, votes)
+
+
+def test_verify_batch_multi():
+    items = []
+    for i, (pk, sk) in enumerate(keys(4)):
+        d = sha512_digest(b"msg%d" % i)
+        items.append((d, pk, Signature.new(d, sk)))
+    Signature.verify_batch_multi(items)
+    d0, pk0, _ = items[0]
+    items[0] = (d0, pk0, Signature(bytes(64)))
+    with pytest.raises(CryptoError):
+        Signature.verify_batch_multi(items)
+
+
+def test_signature_service():
+    async def run():
+        pk, sk = keys(1)[0]
+        service = SignatureService(sk)
+        d = sha512_digest(b"service")
+        sig = await service.request_signature(d)
+        sig.verify(d, pk)
+
+    asyncio.run(run())
+
+
+# ---------------------------------------------------------------------------
+# Pure-python RFC 8032 oracle cross-checks.
+# ---------------------------------------------------------------------------
+
+
+def test_oracle_matches_openssl_keys_and_sigs():
+    rng = random.Random(7)
+    for _ in range(4):
+        seed = rng.randbytes(32)
+        pk, sk = generate_keypair(seed=seed)
+        assert ref.secret_to_public(seed) == pk.data
+        msg = rng.randbytes(32)
+        sig_ref = ref.sign(seed, msg)
+        sig_ssl = Signature.new(Digest(msg), sk).data
+        assert sig_ref == sig_ssl  # Ed25519 signing is deterministic
+        assert ref.verify(pk.data, msg, sig_ssl, strict=True)
+        assert ref.verify(pk.data, msg, sig_ssl, strict=False)
+
+
+def test_oracle_rejects_tampered():
+    seed = random.Random(3).randbytes(32)
+    pub = ref.secret_to_public(seed)
+    msg = b"m" * 32
+    sig = bytearray(ref.sign(seed, msg))
+    sig[5] ^= 1
+    assert not ref.verify(pub, msg, bytes(sig))
+
+
+def test_oracle_rlc_batch():
+    rng = random.Random(11)
+    items = []
+    for i in range(6):
+        seed = rng.randbytes(32)
+        pub = ref.secret_to_public(seed)
+        msg = rng.randbytes(32)
+        items.append((pub, msg, ref.sign(seed, msg)))
+    assert ref.verify_batch_rlc(items, rng=rng)
+    # Tamper one message.
+    pub, msg, sig = items[2]
+    items[2] = (pub, b"x" * 32, sig)
+    assert not ref.verify_batch_rlc(items, rng=rng)
+
+
+def test_oracle_point_roundtrip():
+    rng = random.Random(13)
+    for _ in range(4):
+        s = rng.getrandbits(250)
+        pt = ref.point_mul(s, ref.G)
+        enc = ref.point_compress(pt)
+        dec = ref.point_decompress(enc)
+        assert dec is not None and ref.point_equal(pt, dec)
+
+
+def test_cofactored_batch_semantics_unified():
+    """A signature whose R carries an 8-torsion component fails strict
+    (cofactorless) verification but passes cofactored verification; the CPU
+    batch backend must ACCEPT it, matching the TPU backend's (and dalek
+    verify_batch's) cofactored acceptance set, so mixed-backend committees
+    never split on QC validity."""
+    rng = random.Random(21)
+    seed = rng.randbytes(32)
+    a, _ = ref.secret_expand(seed)
+    pub = ref.point_compress(ref.point_mul(a, ref.G))
+    msg = rng.randbytes(32)
+    t8 = ref.torsion_generator()
+    r = rng.getrandbits(250) % ref.L
+    r_pt = ref.point_add(ref.point_mul(r, ref.G), t8)
+    r_enc = ref.point_compress(r_pt)
+    h = ref.compute_challenge(r_enc, pub, msg)
+    s = (r + h * a) % ref.L
+    sig = r_enc + int.to_bytes(s, 32, "little")
+
+    assert not ref.verify(pub, msg, sig, strict=True)
+    assert ref.verify(pub, msg, sig, strict=False)
+    # Cofactored batch acceptance on the CPU backend (no raise):
+    CpuBackend().verify_batch([msg], [pub], [sig])
+    # ...and the strict single-signature path still rejects it:
+    with pytest.raises(CryptoError):
+        Signature(sig).verify(Digest(msg), PublicKey(pub))
+
+
+def test_oracle_decompress_rejects_noncanonical():
+    # y = p (non-canonical encoding of 0)
+    bad = int.to_bytes(ref.P, 32, "little")
+    assert ref.point_decompress(bad) is None
