@@ -20,6 +20,7 @@ import base64
 import hashlib
 import os
 import secrets
+import time
 
 from cryptography.exceptions import InvalidSignature
 from cryptography.hazmat.primitives.asymmetric.ed25519 import (
@@ -293,6 +294,33 @@ class CpuBackend:
 
     name = "cpu"
 
+    # The pure-Python cofactored re-check costs ~6.5 ms; it only ever runs on
+    # signatures OpenSSL rejected, which honest RFC 8032 signers never produce
+    # in the divergence region (their R = rB is torsion-free, so OpenSSL
+    # rejection == cofactored rejection for them). A token bucket bounds the
+    # CPU amplification a byzantine committee member could otherwise extract;
+    # once exhausted, OpenSSL's verdict is final — this can only reject
+    # byzantine-crafted torsioned signatures, never honest ones.
+    SLOW_CHECK_BUDGET = 32
+    SLOW_CHECK_REFILL_S = 10.0
+
+    def __init__(self) -> None:
+        self._slow_tokens = float(self.SLOW_CHECK_BUDGET)
+        self._last_refill = time.monotonic()
+
+    def _take_slow_token(self) -> bool:
+        now = time.monotonic()
+        self._slow_tokens = min(
+            float(self.SLOW_CHECK_BUDGET),
+            self._slow_tokens
+            + (now - self._last_refill) * self.SLOW_CHECK_BUDGET / self.SLOW_CHECK_REFILL_S,
+        )
+        self._last_refill = now
+        if self._slow_tokens >= 1.0:
+            self._slow_tokens -= 1.0
+            return True
+        return False
+
     def verify_batch(self, msgs, pubs, sigs) -> None:
         if not len(msgs) == len(pubs) == len(sigs):
             raise CryptoError("batch length mismatch")
@@ -300,6 +328,11 @@ class CpuBackend:
             try:
                 Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
             except (InvalidSignature, ValueError):
+                if not self._take_slow_token():
+                    raise CryptoError(
+                        "invalid signature in batch (cofactored re-check "
+                        "rate-limited; rejecting conservatively)"
+                    ) from None
                 if not ed25519_ref.verify(pub, msg, sig, strict=False):
                     raise CryptoError("invalid signature in batch") from None
 

@@ -1,0 +1,33 @@
+"""TPU batch-verification backend (JAX device kernels).
+
+Routes ``Signature.verify_batch`` to the device random-linear-combination
+verifier in ``hotstuff_tpu.ops`` — the north-star offload of the QC hot path
+(reference ``crypto/src/lib.rs:206-219``). Acceptance semantics: cofactored
+(dalek ``verify_batch``-equivalent), identical to ``CpuBackend``.
+"""
+
+from __future__ import annotations
+
+from . import CryptoError
+
+
+class TpuBackend:
+    name = "tpu"
+
+    def __init__(self) -> None:
+        try:
+            from hotstuff_tpu.ops import verify as _ops_verify  # noqa: F401
+        except ImportError as e:  # pragma: no cover
+            raise NotImplementedError(
+                "the TPU crypto backend requires hotstuff_tpu.ops.verify "
+                "(jax device kernels); not available: %s" % e
+            ) from e
+        self._ops = _ops_verify
+
+    def verify_batch(self, msgs, pubs, sigs) -> None:
+        if not len(msgs) == len(pubs) == len(sigs):
+            raise CryptoError("batch length mismatch")
+        if not msgs:
+            return
+        if not self._ops.verify_batch_device(msgs, pubs, sigs):
+            raise CryptoError("invalid signature in batch (device)")

@@ -1,0 +1,31 @@
+"""Network layer: framed TCP actors.
+
+The protocol plane's transport (the "DCN plane" in TPU terms — XLA
+collectives over ICI appear only inside the device crypto kernels, never for
+protocol messages). Same three abstractions and wire behavior as the
+reference network crate (``network/src/lib.rs:11-13``):
+
+- ``Receiver`` + ``MessageHandler``: accept loop, one runner per connection,
+  4-byte big-endian length-delimited frames, handler may write replies/ACKs
+  on the same socket (reference ``network/src/receiver.rs:20-88``).
+- ``SimpleSender``: best-effort, one connection task per peer, no retry
+  (reference ``network/src/simple_sender.rs:22-143``).
+- ``ReliableSender``: at-least-once with per-message ``CancelHandler``
+  resolved by the peer's ACK; exponential-backoff reconnect with replay of
+  un-ACKed messages (reference ``network/src/reliable_sender.rs:140-247``).
+"""
+
+from .receiver import MessageHandler, Receiver, FramedWriter, read_frame, write_frame
+from .simple_sender import SimpleSender
+from .reliable_sender import CancelHandler, ReliableSender
+
+__all__ = [
+    "MessageHandler",
+    "Receiver",
+    "FramedWriter",
+    "SimpleSender",
+    "ReliableSender",
+    "CancelHandler",
+    "read_frame",
+    "write_frame",
+]

@@ -1,0 +1,98 @@
+"""TCP receiver: accept loop + per-connection runners dispatching frames to a
+user-supplied handler (reference ``network/src/receiver.rs:38-88``)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import struct
+
+log = logging.getLogger("network")
+
+_LEN = struct.Struct(">I")
+MAX_FRAME = 64 * 1024 * 1024
+
+
+class FrameError(Exception):
+    pass
+
+
+async def read_frame(reader: asyncio.StreamReader) -> bytes:
+    hdr = await reader.readexactly(4)
+    (n,) = _LEN.unpack(hdr)
+    if n > MAX_FRAME:
+        raise FrameError(f"frame length {n} exceeds MAX_FRAME")
+    return await reader.readexactly(n)
+
+
+def write_frame(writer: asyncio.StreamWriter, payload: bytes) -> None:
+    writer.write(_LEN.pack(len(payload)) + payload)
+
+
+class FramedWriter:
+    """Reply-side of a connection handed to ``MessageHandler.dispatch`` —
+    the channel receivers use to write ACKs back on the same socket
+    (reference ``network/src/receiver.rs:20-27``)."""
+
+    __slots__ = ("_writer",)
+
+    def __init__(self, writer: asyncio.StreamWriter) -> None:
+        self._writer = writer
+
+    async def send(self, payload: bytes) -> None:
+        write_frame(self._writer, payload)
+        await self._writer.drain()
+
+
+class MessageHandler:
+    """Dispatch one frame; may await replies via ``writer.send``."""
+
+    async def dispatch(self, writer: FramedWriter, message: bytes) -> None:
+        raise NotImplementedError
+
+
+class Receiver:
+    """Listens on ``(host, port)``; spawns one runner task per connection."""
+
+    def __init__(self, address: tuple[str, int], handler: MessageHandler) -> None:
+        self.address = address
+        self.handler = handler
+        self._server: asyncio.base_events.Server | None = None
+        self._writers: set[asyncio.StreamWriter] = set()
+
+    @classmethod
+    async def spawn(cls, address: tuple[str, int], handler: MessageHandler) -> "Receiver":
+        self = cls(address, handler)
+        host, port = address
+        self._server = await asyncio.start_server(self._on_connection, host, port)
+        log.debug("listening on %s:%d", host, port)
+        return self
+
+    async def _on_connection(
+        self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
+    ) -> None:
+        peer = writer.get_extra_info("peername")
+        framed = FramedWriter(writer)
+        self._writers.add(writer)
+        try:
+            while True:
+                frame = await read_frame(reader)
+                await self.handler.dispatch(framed, frame)
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass  # peer went away — normal
+        except FrameError as e:
+            log.warning("bad frame from %s: %s", peer, e)
+        except Exception:
+            log.exception("handler error for peer %s", peer)
+        finally:
+            self._writers.discard(writer)
+            writer.close()
+
+    async def shutdown(self) -> None:
+        if self._server is not None:
+            self._server.close()
+            # Close lingering peer connections: Python 3.12's wait_closed()
+            # waits for all client transports, and senders keep theirs open.
+            for w in list(self._writers):
+                w.close()
+            await self._server.wait_closed()

@@ -1,0 +1,127 @@
+"""At-least-once sender (reference ``network/src/reliable_sender.rs``).
+
+``send`` returns a ``CancelHandler`` — a future resolved with the peer's ACK
+bytes. Per-peer connection tasks reconnect with exponential backoff (200 ms,
+x2, capped 60 s) and replay every un-ACKed message across reconnects
+(reference ``reliable_sender.rs:131,166,185-247``). Dropping/cancelling the
+handler cancels the message: it is skipped on replay and its ACK discarded
+(reference ``reliable_sender.rs:175,195-197``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from collections import deque
+
+from .receiver import read_frame, write_frame
+
+log = logging.getLogger("network")
+
+QUEUE_CAPACITY = 1_000
+RETRY_DELAY_MS = 200
+RETRY_CAP_MS = 60_000
+
+CancelHandler = asyncio.Future  # resolves to the peer's ACK bytes
+
+
+class _Connection:
+    def __init__(self, address: tuple[str, int]) -> None:
+        self.address = address
+        self.queue: asyncio.Queue[tuple[bytes, CancelHandler]] = asyncio.Queue(
+            QUEUE_CAPACITY
+        )
+        # Messages sent but not yet ACKed, FIFO; replayed on reconnect.
+        self.pending: deque[tuple[bytes, CancelHandler]] = deque()
+        self.task = asyncio.create_task(self._keep_alive())
+
+    async def _keep_alive(self) -> None:
+        host, port = self.address
+        delay = RETRY_DELAY_MS
+        while True:
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError as e:
+                log.debug("retrying %s:%d in %dms: %s", host, port, delay, e)
+                await asyncio.sleep(delay / 1000)
+                delay = min(delay * 2, RETRY_CAP_MS)
+                continue
+            delay = RETRY_DELAY_MS
+            try:
+                await self._run(reader, writer)
+            except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+                log.debug("connection to %s:%d dropped: %s", host, port, e)
+            finally:
+                writer.close()
+
+    async def _run(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
+        # Replay un-ACKed, un-cancelled messages from the previous connection.
+        self.pending = deque(
+            (d, h) for d, h in self.pending if not h.cancelled()
+        )
+        for data, _ in self.pending:
+            write_frame(writer, data)
+        await writer.drain()
+
+        ack_task = asyncio.create_task(read_frame(reader))
+        queue_task = asyncio.create_task(self.queue.get())
+        try:
+            while True:
+                done, _ = await asyncio.wait(
+                    {ack_task, queue_task}, return_when=asyncio.FIRST_COMPLETED
+                )
+                if queue_task in done:
+                    data, handler = queue_task.result()
+                    queue_task = asyncio.create_task(self.queue.get())
+                    if handler.cancelled():
+                        continue
+                    self.pending.append((data, handler))
+                    write_frame(writer, data)
+                    await writer.drain()
+                if ack_task in done:
+                    ack = ack_task.result()  # raises on disconnect
+                    ack_task = asyncio.create_task(read_frame(reader))
+                    # Pair the ACK with the oldest live pending message.
+                    while self.pending:
+                        _, handler = self.pending.popleft()
+                        if handler.cancelled():
+                            continue
+                        handler.set_result(ack)
+                        break
+        finally:
+            ack_task.cancel()
+            queue_task.cancel()
+
+
+class ReliableSender:
+    def __init__(self) -> None:
+        self._connections: dict[tuple[str, int], _Connection] = {}
+
+    def _connection(self, address: tuple[str, int]) -> _Connection:
+        conn = self._connections.get(address)
+        if conn is None or conn.task.done():
+            conn = _Connection(address)
+            self._connections[address] = conn
+        return conn
+
+    def send(self, address: tuple[str, int], data: bytes) -> CancelHandler:
+        """Queue one frame for ``address``; the returned handler resolves
+        with the peer's ACK bytes (reference ``reliable_sender.rs:60-72``)."""
+        handler: CancelHandler = asyncio.get_running_loop().create_future()
+        conn = self._connection(address)
+        try:
+            conn.queue.put_nowait((data, handler))
+        except asyncio.QueueFull:
+            handler.cancel()
+            log.warning("dropping reliable message to %s: channel full", address)
+        return handler
+
+    def broadcast(
+        self, addresses: list[tuple[str, int]], data: bytes
+    ) -> list[CancelHandler]:
+        return [self.send(addr, data) for addr in addresses]
+
+    def shutdown(self) -> None:
+        for conn in self._connections.values():
+            conn.task.cancel()
+        self._connections.clear()

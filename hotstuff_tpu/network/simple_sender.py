@@ -1,0 +1,94 @@
+"""Best-effort sender (reference ``network/src/simple_sender.rs:22-143``).
+
+One connection task per peer holding a bounded queue; no retry — on socket
+error the connection task dies and queued messages are dropped; the next
+``send`` to that peer spawns a fresh connection. Replies from the peer are
+read and discarded (keeps the socket's receive window drained).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import random
+
+from .receiver import read_frame, write_frame
+
+log = logging.getLogger("network")
+
+QUEUE_CAPACITY = 1_000
+
+
+class _Connection:
+    def __init__(self, address: tuple[str, int]) -> None:
+        self.address = address
+        self.queue: asyncio.Queue[bytes] = asyncio.Queue(QUEUE_CAPACITY)
+        self.task = asyncio.create_task(self._run())
+
+    async def _run(self) -> None:
+        host, port = self.address
+        try:
+            reader, writer = await asyncio.open_connection(host, port)
+        except OSError as e:
+            log.debug("failed to connect to %s:%d: %s", host, port, e)
+            return
+        sink = asyncio.create_task(self._sink_replies(reader))
+        try:
+            while True:
+                data = await self.queue.get()
+                write_frame(writer, data)
+                await writer.drain()
+        except (ConnectionError, OSError) as e:
+            log.debug("connection to %s:%d died: %s", host, port, e)
+        finally:
+            sink.cancel()
+            writer.close()
+
+    async def _sink_replies(self, reader: asyncio.StreamReader) -> None:
+        try:
+            while True:
+                await read_frame(reader)
+        except Exception:
+            pass
+
+    def try_send(self, data: bytes) -> bool:
+        if self.task.done():
+            return False
+        try:
+            self.queue.put_nowait(data)
+            return True
+        except asyncio.QueueFull:
+            log.warning("dropping message to %s: channel full", self.address)
+            return True  # best-effort: dropped, but connection is alive
+
+
+class SimpleSender:
+    def __init__(self) -> None:
+        self._connections: dict[tuple[str, int], _Connection] = {}
+        self._rng = random.Random()
+
+    def send(self, address: tuple[str, int], data: bytes) -> None:
+        """Fire-and-forget one frame to ``address``."""
+        conn = self._connections.get(address)
+        if conn is None or not conn.try_send(data):
+            conn = _Connection(address)
+            self._connections[address] = conn
+            conn.try_send(data)
+
+    def broadcast(self, addresses: list[tuple[str, int]], data: bytes) -> None:
+        for addr in addresses:
+            self.send(addr, data)
+
+    def lucky_broadcast(
+        self, addresses: list[tuple[str, int]], data: bytes, nodes: int
+    ) -> None:
+        """Send to ``nodes`` randomly-picked addresses (reference
+        ``simple_sender.rs:76-85``) — the sync-retry gossip primitive."""
+        picked = self._rng.sample(addresses, min(nodes, len(addresses)))
+        for addr in picked:
+            self.send(addr, data)
+
+    def shutdown(self) -> None:
+        for conn in self._connections.values():
+            conn.task.cancel()
+        self._connections.clear()

@@ -6,10 +6,22 @@ reference ``consensus/src/tests/common.rs:17-46,182-198``)."""
 from __future__ import annotations
 
 import asyncio
+import functools
 import random
 import struct
 
 from hotstuff_tpu.crypto import PublicKey, SecretKey, generate_keypair
+
+
+def async_test(fn):
+    """Run an ``async def`` test on a fresh event loop (no pytest-asyncio in
+    this environment)."""
+
+    @functools.wraps(fn)
+    def wrapper(*args, **kwargs):
+        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60))
+
+    return wrapper
 
 
 def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:
@@ -38,6 +50,11 @@ async def listener(port: int, expected: bytes | None = None, reply: bytes = b"Ac
         except (asyncio.IncompleteReadError, ConnectionError):
             if not received.done():
                 received.set_exception(ConnectionError("listener connection died"))
+        finally:
+            # One-shot: close our side so Server.wait_closed() (which waits
+            # for client transports on Python 3.12) cannot hang on senders
+            # that keep their connection open.
+            writer.close()
 
     server = await asyncio.start_server(handle, "127.0.0.1", port)
     try:

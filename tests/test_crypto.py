@@ -202,6 +202,21 @@ def test_cofactored_batch_semantics_unified():
         Signature(sig).verify(Digest(msg), PublicKey(pub))
 
 
+def test_slow_recheck_rate_limiter():
+    """Crafted invalid signatures must not buy unbounded pure-Python work:
+    after the token bucket drains, OpenSSL's rejection is final."""
+    backend = CpuBackend()
+    backend.SLOW_CHECK_BUDGET = 2
+    backend._slow_tokens = 2.0
+    pk, sk = keys(1)[0]
+    d = sha512_digest(b"real")
+    wrong = Signature.new(sha512_digest(b"other"), sk)
+    for _ in range(4):
+        with pytest.raises(CryptoError):
+            backend.verify_batch([d.data], [pk.data], [wrong.data])
+    assert backend._slow_tokens < 1.0  # bucket drained; fast-path rejections
+
+
 def test_oracle_decompress_rejects_noncanonical():
     # y = p (non-canonical encoding of 0)
     bad = int.to_bytes(ref.P, 32, "little")

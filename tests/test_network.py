@@ -1,0 +1,152 @@
+"""Network tests — coverage modeled on the reference network crate tests:
+receiver dispatch, simple/reliable send + broadcast, and reliable retry
+(send before any listener exists; listener comes up later; ACK still
+arrives — reference ``network/src/tests/reliable_sender_tests.rs:50-67``)."""
+
+import asyncio
+
+from hotstuff_tpu.network import (
+    MessageHandler,
+    Receiver,
+    ReliableSender,
+    SimpleSender,
+)
+from hotstuff_tpu.network.receiver import read_frame, write_frame
+
+from .common import async_test, listener
+
+BASE_PORT = 17000  # distinct per-test ports, like the reference fixtures
+
+
+class _EchoHandler(MessageHandler):
+    def __init__(self):
+        self.received = []
+
+    async def dispatch(self, writer, message: bytes) -> None:
+        self.received.append(message)
+        await writer.send(b"Ack")
+
+
+@async_test
+async def test_receiver_dispatch():
+    handler = _EchoHandler()
+    receiver = await Receiver.spawn(("127.0.0.1", BASE_PORT), handler)
+    reader, writer = await asyncio.open_connection("127.0.0.1", BASE_PORT)
+    write_frame(writer, b"hello")
+    await writer.drain()
+    assert await read_frame(reader) == b"Ack"
+    write_frame(writer, b"again")
+    await writer.drain()
+    assert await read_frame(reader) == b"Ack"
+    assert handler.received == [b"hello", b"again"]
+    writer.close()
+    await receiver.shutdown()
+
+
+@async_test
+async def test_simple_send():
+    addr = ("127.0.0.1", BASE_PORT + 1)
+    task = asyncio.create_task(listener(BASE_PORT + 1, expected=b"payload"))
+    await asyncio.sleep(0.05)
+    sender = SimpleSender()
+    sender.send(addr, b"payload")
+    assert await task == b"payload"
+    sender.shutdown()
+
+
+@async_test
+async def test_simple_broadcast():
+    ports = [BASE_PORT + 2 + i for i in range(3)]
+    tasks = [asyncio.create_task(listener(p, expected=b"bcast")) for p in ports]
+    await asyncio.sleep(0.05)
+    sender = SimpleSender()
+    sender.broadcast([("127.0.0.1", p) for p in ports], b"bcast")
+    assert await asyncio.gather(*tasks) == [b"bcast"] * 3
+    sender.shutdown()
+
+
+@async_test
+async def test_reliable_send_resolves_with_ack():
+    port = BASE_PORT + 10
+    task = asyncio.create_task(listener(port, expected=b"important"))
+    await asyncio.sleep(0.05)
+    sender = ReliableSender()
+    handler = sender.send(("127.0.0.1", port), b"important")
+    assert await asyncio.wait_for(handler, 5) == b"Ack"
+    await task
+    sender.shutdown()
+
+
+@async_test
+async def test_reliable_broadcast():
+    ports = [BASE_PORT + 11 + i for i in range(3)]
+    tasks = [asyncio.create_task(listener(p)) for p in ports]
+    await asyncio.sleep(0.05)
+    sender = ReliableSender()
+    handlers = sender.broadcast([("127.0.0.1", p) for p in ports], b"rb")
+    acks = await asyncio.gather(*handlers)
+    assert acks == [b"Ack"] * 3
+    await asyncio.gather(*tasks)
+    sender.shutdown()
+
+
+@async_test
+async def test_reliable_retry_before_listener_exists():
+    """The at-least-once contract: the message is sent while nobody is
+    listening; the listener appears later; the ACK still arrives."""
+    port = BASE_PORT + 20
+    sender = ReliableSender()
+    handler = sender.send(("127.0.0.1", port), b"retry-me")
+    await asyncio.sleep(0.4)  # let at least one connect attempt fail
+    assert not handler.done()
+    payload = await asyncio.wait_for(
+        asyncio.gather(listener(port, expected=b"retry-me"), handler), 15
+    )
+    assert payload[1] == b"Ack"
+    sender.shutdown()
+
+
+@async_test
+async def test_reliable_replays_unacked_on_reconnect():
+    """A connection that dies before ACKing: the message must be replayed to
+    the next listener on the same address."""
+    port = BASE_PORT + 21
+
+    # First listener: accepts, reads the frame, then hangs up WITHOUT acking.
+    got_first = asyncio.get_running_loop().create_future()
+
+    async def rude(reader, writer):
+        frame = await read_frame(reader)
+        if not got_first.done():
+            got_first.set_result(frame)
+        writer.close()
+
+    server = await asyncio.start_server(rude, "127.0.0.1", port)
+    sender = ReliableSender()
+    handler = sender.send(("127.0.0.1", port), b"replay-me")
+    assert await asyncio.wait_for(got_first, 5) == b"replay-me"
+    server.close()
+    await server.wait_closed()
+    assert not handler.done()
+
+    # Second listener on the same port ACKs properly.
+    result = await asyncio.wait_for(
+        asyncio.gather(listener(port, expected=b"replay-me"), handler), 15
+    )
+    assert result[1] == b"Ack"
+    sender.shutdown()
+
+
+@async_test
+async def test_cancelled_handler_skips_replay():
+    port = BASE_PORT + 22
+    sender = ReliableSender()
+    h1 = sender.send(("127.0.0.1", port), b"cancelled")
+    h2 = sender.send(("127.0.0.1", port), b"kept")
+    h1.cancel()
+    await asyncio.sleep(0.3)
+    payload, ack = await asyncio.wait_for(
+        asyncio.gather(listener(port, expected=b"kept"), h2), 15
+    )
+    assert ack == b"Ack"
+    sender.shutdown()
