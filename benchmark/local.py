@@ -1,0 +1,206 @@
+"""Local benchmark: boot a committee of real node processes plus load-
+generating clients on localhost, then parse their logs into the SUMMARY
+block (reference ``benchmark/benchmark/local.py``).
+
+Differences from the reference: processes are supervised directly (no tmux)
+and there is no cargo build step (Python nodes launch as subprocesses with
+stderr redirected to per-role log files, like the reference's
+``local.py:25-28``).
+"""
+
+from __future__ import annotations
+
+import os
+import shutil
+import signal
+import subprocess
+import sys
+import time
+
+from hotstuff_tpu.consensus import Authority as CAuth
+from hotstuff_tpu.consensus import Committee as CCommittee
+from hotstuff_tpu.consensus import Parameters as CParams
+from hotstuff_tpu.mempool import Authority as MAuth
+from hotstuff_tpu.mempool import Committee as MCommittee
+from hotstuff_tpu.mempool import Parameters as MParams
+from hotstuff_tpu.node.config import Committee, Parameters, Secret
+
+from .logs import LogParser
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+class BenchError(Exception):
+    pass
+
+
+class LocalBench:
+    """Reference flow (``local.py:37-121``): clean state, generate N key
+    files + committee json, start each client & node with stderr->logfile,
+    sleep for the duration, kill, parse logs."""
+
+    def __init__(
+        self,
+        nodes: int = 4,
+        rate: int = 1_000,
+        tx_size: int = 512,
+        duration: int = 20,
+        faults: int = 0,
+        base_port: int = 9000,
+        timeout_delay: int = 1_000,
+        batch_size: int = 15_000,
+        max_batch_delay: int = 10,
+        work_dir: str = ".bench",
+        crypto_backend: str = "cpu",
+    ) -> None:
+        self.nodes = nodes
+        self.rate = rate
+        self.tx_size = tx_size
+        self.duration = duration
+        self.faults = faults
+        self.base_port = base_port
+        self.timeout_delay = timeout_delay
+        self.batch_size = batch_size
+        self.max_batch_delay = max_batch_delay
+        self.work_dir = os.path.abspath(work_dir)
+        self.crypto_backend = crypto_backend
+        self._procs: list[subprocess.Popen] = []
+
+    def _cleanup(self) -> None:
+        for p in self._procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGKILL)
+        self._procs.clear()
+
+    @staticmethod
+    def _wait_for_ports(addresses, timeout: float) -> None:
+        import socket
+
+        deadline = time.monotonic() + timeout
+        for host, port in addresses:
+            while True:
+                try:
+                    with socket.create_connection((host, port), timeout=1):
+                        break
+                except OSError:
+                    if time.monotonic() > deadline:
+                        raise BenchError(
+                            f"node on {host}:{port} did not come up in time"
+                        ) from None
+                    time.sleep(0.5)
+
+    def run(self, debug: bool = False) -> LogParser:
+        shutil.rmtree(self.work_dir, ignore_errors=True)
+        os.makedirs(self.work_dir, exist_ok=True)
+        logs_dir = os.path.join(self.work_dir, "logs")
+        os.makedirs(logs_dir)
+
+        # Keys + committee (reference port layout: consensus, front, mempool
+        # blocks of N ports each, ``config.py:81-90``).
+        secrets = [Secret.new() for _ in range(self.nodes)]
+        n = self.nodes
+        consensus = CCommittee(
+            authorities={
+                s.name: CAuth(stake=1, address=("127.0.0.1", self.base_port + i))
+                for i, s in enumerate(secrets)
+            }
+        )
+        mempool = MCommittee(
+            authorities={
+                s.name: MAuth(
+                    stake=1,
+                    transactions_address=("127.0.0.1", self.base_port + n + i),
+                    mempool_address=("127.0.0.1", self.base_port + 2 * n + i),
+                )
+                for i, s in enumerate(secrets)
+            }
+        )
+        committee_file = os.path.join(self.work_dir, "committee.json")
+        Committee(consensus, mempool).write(committee_file)
+        params_file = os.path.join(self.work_dir, "parameters.json")
+        Parameters(
+            CParams(timeout_delay=self.timeout_delay),
+            MParams(batch_size=self.batch_size, max_batch_delay=self.max_batch_delay),
+        ).write(params_file)
+
+        key_files = []
+        for i, s in enumerate(secrets):
+            kf = os.path.join(self.work_dir, f"node_{i}.json")
+            s.write(kf)
+            key_files.append(kf)
+
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
+        env["HOTSTUFF_CRYPTO_BACKEND"] = self.crypto_backend
+
+        booted = self.nodes - self.faults  # faults = don't boot the last f
+        try:
+            # Boot clients first (they wait for node ports), then nodes
+            # (reference ``remote.py:177-219`` order).
+            for i in range(booted):
+                front = f"127.0.0.1:{self.base_port + n + i}"
+                node_addrs = [
+                    f"127.0.0.1:{self.base_port + n + j}" for j in range(booted)
+                ]
+                log_file = open(os.path.join(logs_dir, f"client-{i}.log"), "w")
+                self._procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-m",
+                            "hotstuff_tpu.node.client",
+                            front,
+                            "--size",
+                            str(self.tx_size),
+                            "--rate",
+                            str(self.rate // booted),
+                            "--timeout",
+                            str(self.timeout_delay),
+                            "--nodes",
+                            *node_addrs,
+                        ],
+                        stderr=log_file,
+                        env=env,
+                        cwd=REPO_ROOT,
+                    )
+                )
+            for i in range(booted):
+                log_file = open(os.path.join(logs_dir, f"node-{i}.log"), "w")
+                self._procs.append(
+                    subprocess.Popen(
+                        [
+                            sys.executable,
+                            "-m",
+                            "hotstuff_tpu.node",
+                            # default verbosity is INFO; -v adds DEBUG, which
+                            # would skew the measured window.
+                            *(["-v"] if debug else []),
+                            "run",
+                            "--keys",
+                            key_files[i],
+                            "--committee",
+                            committee_file,
+                            "--store",
+                            os.path.join(self.work_dir, f"db_{i}"),
+                            "--parameters",
+                            params_file,
+                        ],
+                        stderr=log_file,
+                        env=env,
+                        cwd=REPO_ROOT,
+                    )
+                )
+
+            # Python interpreter startup is expensive (~2s CPU each on this
+            # class of machine) and all processes compete for cores: don't
+            # start the measurement clock until every node actually listens.
+            self._wait_for_ports(
+                [("127.0.0.1", self.base_port + i) for i in range(booted)],
+                timeout=30 * booted,
+            )
+            time.sleep(2 * self.timeout_delay / 1000)
+            time.sleep(self.duration)
+        finally:
+            self._cleanup()
+
+        return LogParser.process(logs_dir, faults=self.faults)

@@ -1,0 +1,217 @@
+"""Log parser — the measurement system (reference
+``benchmark/benchmark/logs.py``).
+
+Scrapes client + node logs with the same regex contract as the reference
+harness (our nodes emit the identical line formats):
+
+- consensus TPS/BPS: committed batch bytes over [first proposal, last commit]
+- consensus latency: commit_ts - proposal_ts per batch digest
+- e2e TPS: committed batch bytes over [client start, last commit]
+- e2e latency: commit_ts - client_send_ts per sample transaction
+
+Multi-node timestamps are merged keeping the earliest (``logs.py:64-71``);
+the parser doubles as the correctness oracle: tracebacks/errors in any log
+raise ParseError (``logs.py:74-75,91-92``).
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+from datetime import datetime
+from re import findall, search
+from statistics import mean
+
+
+class ParseError(Exception):
+    pass
+
+
+def _to_posix(ts: str) -> float:
+    return datetime.fromisoformat(ts.replace("Z", "+00:00")).timestamp()
+
+
+def _merge_earliest(dicts) -> dict:
+    merged: dict = {}
+    for d in dicts:
+        for k, v in d.items():
+            if k not in merged or merged[k] > v:
+                merged[k] = v
+    return merged
+
+
+class LogParser:
+    def __init__(self, clients: list[str], nodes: list[str], faults: int = 0) -> None:
+        if not clients or not nodes:
+            raise ParseError("missing client or node logs")
+        self.faults = faults
+        self.committee_size = len(nodes) + faults
+
+        results = [self._parse_client(log) for log in clients]
+        self.sizes_cfg, self.rate, self.start, misses, self.sent_samples = zip(
+            *results
+        )
+        self.misses = sum(misses)
+
+        results = [self._parse_node(log) for log in nodes]
+        proposals, commits, sizes, received, timeouts, self.configs = zip(*results)
+        self.proposals = _merge_earliest(proposals)
+        self.commits = _merge_earliest(commits)
+        self.batch_sizes = {
+            k: v for x in sizes for k, v in x.items() if k in self.commits
+        }
+        self.received_samples = received
+        self.timeouts = max(timeouts)
+
+        if self.misses:
+            print(f"WARN: clients missed their target rate {self.misses:,} time(s)")
+        if self.timeouts > 2:
+            print(f"WARN: nodes timed out {self.timeouts:,} time(s)")
+
+    def _parse_client(self, log: str):
+        if search(r"Traceback|ERROR", log) is not None:
+            raise ParseError("client(s) panicked")
+        size = int(search(r"Transactions size: (\d+)", log).group(1))
+        rate = int(search(r"Transactions rate: (\d+)", log).group(1))
+        start = _to_posix(search(r"\[(.*Z) .* Start ", log).group(1))
+        misses = len(findall(r"rate too high", log))
+        samples = {
+            int(s): _to_posix(t)
+            for t, s in findall(r"\[(.*Z) .* sample transaction (\d+)", log)
+        }
+        return size, rate, start, misses, samples
+
+    def _parse_node(self, log: str):
+        if search(r"Traceback|panic", log) is not None:
+            raise ParseError("node(s) panicked")
+
+        proposals = _merge_earliest(
+            [
+                {d: _to_posix(t)}
+                for t, d in findall(r"\[(.*Z) .* Created B\d+ -> ([^ ]+=)", log)
+            ]
+        )
+        commits = _merge_earliest(
+            [
+                {d: _to_posix(t)}
+                for t, d in findall(r"\[(.*Z) .* Committed B\d+ -> ([^ ]+=)", log)
+            ]
+        )
+        sizes = {
+            d: int(s) for d, s in findall(r"Batch ([^ ]+) contains (\d+) B", log)
+        }
+        samples = {
+            int(s): d
+            for d, s in findall(r"Batch ([^ ]+) contains sample tx (\d+)", log)
+        }
+        timeouts = len(findall(r".* WARN .* Timeout", log))
+
+        configs = {
+            "consensus": {
+                "timeout_delay": int(search(r"Timeout delay .* (\d+)", log).group(1)),
+                "sync_retry_delay": int(
+                    search(r"consensus.* Sync retry delay .* (\d+)", log).group(1)
+                ),
+            },
+            "mempool": {
+                "gc_depth": int(search(r"Garbage collection .* (\d+)", log).group(1)),
+                "sync_retry_delay": int(
+                    search(r"mempool.* Sync retry delay .* (\d+)", log).group(1)
+                ),
+                "sync_retry_nodes": int(
+                    search(r"Sync retry nodes .* (\d+)", log).group(1)
+                ),
+                "batch_size": int(search(r"Batch size .* (\d+)", log).group(1)),
+                "max_batch_delay": int(
+                    search(r"Max batch delay .* (\d+)", log).group(1)
+                ),
+            },
+        }
+        return proposals, commits, sizes, samples, timeouts, configs
+
+    # -- measurements -------------------------------------------------------
+
+    def _consensus_throughput(self):
+        if not self.commits:
+            return 0, 0, 0
+        start, end = min(self.proposals.values()), max(self.commits.values())
+        duration = end - start
+        nbytes = sum(self.batch_sizes.values())
+        bps = nbytes / duration if duration else 0
+        tps = bps / self.sizes_cfg[0]
+        return tps, bps, duration
+
+    def _consensus_latency(self):
+        lat = [c - self.proposals[d] for d, c in self.commits.items() if d in self.proposals]
+        return mean(lat) if lat else 0
+
+    def _end_to_end_throughput(self):
+        if not self.commits:
+            return 0, 0, 0
+        start, end = min(self.start), max(self.commits.values())
+        duration = end - start
+        nbytes = sum(self.batch_sizes.values())
+        bps = nbytes / duration if duration else 0
+        tps = bps / self.sizes_cfg[0]
+        return tps, bps, duration
+
+    def _end_to_end_latency(self):
+        lat = []
+        for sent, received in zip(self.sent_samples, self.received_samples):
+            for tx_id, batch_id in received.items():
+                if batch_id in self.commits and tx_id in sent:
+                    lat.append(self.commits[batch_id] - sent[tx_id])
+        return mean(lat) if lat else 0
+
+    def result(self) -> str:
+        consensus_latency = self._consensus_latency() * 1000
+        consensus_tps, consensus_bps, _ = self._consensus_throughput()
+        e2e_tps, e2e_bps, duration = self._end_to_end_throughput()
+        e2e_latency = self._end_to_end_latency() * 1000
+        cfg_c = self.configs[0]["consensus"]
+        cfg_m = self.configs[0]["mempool"]
+        return (
+            "\n"
+            "-----------------------------------------\n"
+            " SUMMARY:\n"
+            "-----------------------------------------\n"
+            " + CONFIG:\n"
+            f" Faults: {self.faults} nodes\n"
+            f" Committee size: {self.committee_size} nodes\n"
+            f" Input rate: {sum(self.rate):,} tx/s\n"
+            f" Transaction size: {self.sizes_cfg[0]:,} B\n"
+            f" Execution time: {round(duration):,} s\n"
+            "\n"
+            f" Consensus timeout delay: {cfg_c['timeout_delay']:,} ms\n"
+            f" Consensus sync retry delay: {cfg_c['sync_retry_delay']:,} ms\n"
+            f" Mempool GC depth: {cfg_m['gc_depth']:,} rounds\n"
+            f" Mempool sync retry delay: {cfg_m['sync_retry_delay']:,} ms\n"
+            f" Mempool sync retry nodes: {cfg_m['sync_retry_nodes']:,} nodes\n"
+            f" Mempool batch size: {cfg_m['batch_size']:,} B\n"
+            f" Mempool max batch delay: {cfg_m['max_batch_delay']:,} ms\n"
+            "\n"
+            " + RESULTS:\n"
+            f" Consensus TPS: {round(consensus_tps):,} tx/s\n"
+            f" Consensus BPS: {round(consensus_bps):,} B/s\n"
+            f" Consensus latency: {round(consensus_latency):,} ms\n"
+            "\n"
+            f" End-to-end TPS: {round(e2e_tps):,} tx/s\n"
+            f" End-to-end BPS: {round(e2e_bps):,} B/s\n"
+            f" End-to-end latency: {round(e2e_latency):,} ms\n"
+            "-----------------------------------------\n"
+        )
+
+    def print_to(self, filename: str) -> None:
+        with open(filename, "a") as f:
+            f.write(self.result())
+
+    @classmethod
+    def process(cls, directory: str, faults: int = 0) -> "LogParser":
+        clients, nodes = [], []
+        for fn in sorted(glob.glob(os.path.join(directory, "client-*.log"))):
+            with open(fn) as f:
+                clients.append(f.read())
+        for fn in sorted(glob.glob(os.path.join(directory, "node-*.log"))):
+            with open(fn) as f:
+                nodes.append(f.read())
+        return cls(clients, nodes, faults)

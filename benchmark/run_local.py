@@ -1,0 +1,49 @@
+"""CLI entry for the local benchmark (the ``fab local`` equivalent,
+reference ``benchmark/fabfile.py:11-38``): boots N nodes + clients on
+localhost and prints the SUMMARY block."""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local import LocalBench  # noqa: E402
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description="Run a local hotstuff_tpu benchmark.")
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--rate", type=int, default=1_000, help="total input rate tx/s")
+    p.add_argument("--tx-size", type=int, default=512, help="transaction bytes")
+    p.add_argument("--duration", type=int, default=20, help="benchmark seconds")
+    p.add_argument("--faults", type=int, default=0, help="crash-faulted nodes")
+    p.add_argument("--timeout", type=int, default=1_000, help="consensus timeout ms")
+    p.add_argument("--batch-size", type=int, default=15_000, help="mempool batch B")
+    p.add_argument("--max-batch-delay", type=int, default=10, help="ms")
+    p.add_argument("--base-port", type=int, default=9000)
+    p.add_argument("--work-dir", default=".bench")
+    p.add_argument("--crypto-backend", default="cpu", choices=["cpu", "tpu"])
+    args = p.parse_args()
+
+    bench = LocalBench(
+        nodes=args.nodes,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        faults=args.faults,
+        base_port=args.base_port,
+        timeout_delay=args.timeout,
+        batch_size=args.batch_size,
+        max_batch_delay=args.max_batch_delay,
+        work_dir=args.work_dir,
+        crypto_backend=args.crypto_backend,
+    )
+    parser = bench.run()
+    print(parser.result())
+
+
+if __name__ == "__main__":
+    main()

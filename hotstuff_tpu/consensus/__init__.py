@@ -1,0 +1,18 @@
+"""Consensus layer: 2-chain HotStuff (Jolteon/Diem-style) state-machine
+replication core (reference ``consensus/src/``)."""
+
+from .config import Authority, Committee, Parameters
+from .consensus import Consensus
+from .messages import QC, TC, Block, Timeout, Vote
+
+__all__ = [
+    "Authority",
+    "Committee",
+    "Parameters",
+    "Consensus",
+    "Block",
+    "Vote",
+    "QC",
+    "TC",
+    "Timeout",
+]

@@ -1,0 +1,82 @@
+"""Vote/timeout aggregation into QCs/TCs (reference
+``consensus/src/aggregator.rs``).
+
+``QCMaker`` dedups authors, sums stake, emits the QC exactly once at 2f+1;
+``TCMaker`` likewise for timeouts. Keyed by round (and block digest for
+votes); ``cleanup`` retains only >= the current round.
+
+This is the device batching point for the TPU backend: a QC carries all its
+vote signatures, so ``QC.verify`` on receivers becomes one device call per
+QC; at scale the verifier fuses QCs across rounds into super-batches.
+"""
+
+from __future__ import annotations
+
+from .config import Committee, Round
+from .errors import AuthorityReuse
+from .messages import QC, TC, Timeout, Vote
+
+
+class QCMaker:
+    def __init__(self) -> None:
+        self.weight = 0
+        self.votes = []
+        self.used = set()
+
+    def append(self, vote: Vote, committee: Committee) -> QC | None:
+        if vote.author in self.used:
+            raise AuthorityReuse(str(vote.author))
+        self.used.add(vote.author)
+        self.votes.append((vote.author, vote.signature))
+        self.weight += committee.stake(vote.author)
+        if self.weight >= committee.quorum_threshold():
+            self.weight = 0  # QC is made exactly once
+            return QC(hash=vote.hash, round=vote.round, votes=list(self.votes))
+        return None
+
+
+class TCMaker:
+    def __init__(self) -> None:
+        self.weight = 0
+        self.votes = []
+        self.used = set()
+
+    def append(self, timeout: Timeout, committee: Committee) -> TC | None:
+        if timeout.author in self.used:
+            raise AuthorityReuse(str(timeout.author))
+        self.used.add(timeout.author)
+        self.votes.append((timeout.author, timeout.signature, timeout.high_qc.round))
+        self.weight += committee.stake(timeout.author)
+        if self.weight >= committee.quorum_threshold():
+            self.weight = 0  # TC is made exactly once
+            return TC(round=timeout.round, votes=list(self.votes))
+        return None
+
+
+class Aggregator:
+    def __init__(self, committee: Committee) -> None:
+        self.committee = committee
+        self.votes_aggregators: dict[Round, dict] = {}
+        self.timeouts_aggregators: dict[Round, TCMaker] = {}
+
+    def add_vote(self, vote: Vote) -> QC | None:
+        # NOTE: inherits the reference's DoS caveat (``aggregator.rs:29-30``):
+        # bounded by cleanup() per round advance.
+        return (
+            self.votes_aggregators.setdefault(vote.round, {})
+            .setdefault(vote.digest(), QCMaker())
+            .append(vote, self.committee)
+        )
+
+    def add_timeout(self, timeout: Timeout) -> TC | None:
+        return self.timeouts_aggregators.setdefault(
+            timeout.round, TCMaker()
+        ).append(timeout, self.committee)
+
+    def cleanup(self, round_: Round) -> None:
+        self.votes_aggregators = {
+            k: v for k, v in self.votes_aggregators.items() if k >= round_
+        }
+        self.timeouts_aggregators = {
+            k: v for k, v in self.timeouts_aggregators.items() if k >= round_
+        }

@@ -1,0 +1,67 @@
+"""Consensus configuration (reference ``consensus/src/config.rs``).
+
+One consensus address per node; stake-weighted quorums of 2f+1.
+"""
+
+from __future__ import annotations
+
+import logging
+from dataclasses import dataclass
+
+from hotstuff_tpu.crypto import PublicKey
+
+log = logging.getLogger("consensus")
+
+Stake = int
+Round = int
+
+
+@dataclass
+class Parameters:
+    """Defaults match the reference (``consensus/src/config.rs:16-23``)."""
+
+    timeout_delay: int = 5_000  # ms
+    sync_retry_delay: int = 10_000  # ms
+
+    def log(self) -> None:
+        # Picked up by the benchmark log parser (reference ``config.rs:25-31``).
+        log.info("Timeout delay set to %d ms", self.timeout_delay)
+        log.info("Sync retry delay set to %d ms", self.sync_retry_delay)
+
+
+@dataclass
+class Authority:
+    stake: Stake
+    address: tuple[str, int]
+
+
+@dataclass
+class Committee:
+    authorities: dict[PublicKey, Authority]
+    epoch: int = 1
+
+    def size(self) -> int:
+        return len(self.authorities)
+
+    def stake(self, name: PublicKey) -> Stake:
+        a = self.authorities.get(name)
+        return a.stake if a else 0
+
+    def total_stake(self) -> Stake:
+        return sum(a.stake for a in self.authorities.values())
+
+    def quorum_threshold(self) -> Stake:
+        # 2f+1 out of N=3f+1 by stake (reference ``config.rs:67-72``).
+        return 2 * self.total_stake() // 3 + 1
+
+    def address(self, name: PublicKey) -> tuple[str, int] | None:
+        a = self.authorities.get(name)
+        return a.address if a else None
+
+    def broadcast_addresses(self, name: PublicKey) -> list[tuple[PublicKey, tuple[str, int]]]:
+        """(name, address) of every node except ``name`` (reference
+        ``config.rs:78-84``)."""
+        return [(pk, a.address) for pk, a in self.authorities.items() if pk != name]
+
+    def sorted_keys(self) -> list[PublicKey]:
+        return sorted(self.authorities.keys())

@@ -1,0 +1,138 @@
+"""Consensus root: wires the consensus actors and the network receiver
+(reference ``consensus/src/consensus.rs:45-162``).
+
+Routing: ``SyncRequest`` -> Helper; ``Propose`` is ACKed then sent to the
+Core; ``Vote``/``Timeout``/``TC`` go straight to the Core.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hotstuff_tpu.crypto import PublicKey, SignatureService
+from hotstuff_tpu.network import MessageHandler, Receiver
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.serde import SerdeError
+
+from .config import Committee, Parameters
+from .core import Core
+from .errors import MalformedMessage
+from .helper import Helper
+from .leader import LeaderElector
+from .mempool_driver import MempoolDriver
+from .messages import decode_message
+from .proposer import Proposer
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("consensus")
+
+CHANNEL_CAPACITY = 1_000
+
+
+class ConsensusReceiverHandler(MessageHandler):
+    def __init__(self, tx_consensus: asyncio.Queue, tx_helper: asyncio.Queue) -> None:
+        self.tx_consensus = tx_consensus
+        self.tx_helper = tx_helper
+
+    async def dispatch(self, writer, serialized: bytes) -> None:
+        try:
+            kind, payload = decode_message(serialized)
+        except (SerdeError, MalformedMessage, ValueError) as e:
+            log.warning("failed to decode consensus message: %s", e)
+            return
+        if kind == "sync_request":
+            await self.tx_helper.put(payload)
+        elif kind == "propose":
+            # ACK proposals — the leader's back-pressure signal (reference
+            # ``consensus.rs:144-153``).
+            await writer.send(b"Ack")
+            await self.tx_consensus.put((kind, payload))
+        else:
+            await self.tx_consensus.put((kind, payload))
+
+
+class Consensus:
+    def __init__(self) -> None:
+        self.tasks: list[asyncio.Task] = []
+        self.receivers: list[Receiver] = []
+        self.synchronizer: Synchronizer | None = None
+        self.mempool_driver: MempoolDriver | None = None
+
+    @classmethod
+    async def spawn(
+        cls,
+        name: PublicKey,
+        committee: Committee,
+        parameters: Parameters,
+        signature_service: SignatureService,
+        store: Store,
+        rx_mempool: asyncio.Queue,  # batch digests from mempool
+        tx_mempool: asyncio.Queue,  # Synchronize/Cleanup to mempool
+        tx_commit: asyncio.Queue,  # committed blocks out
+        benchmark: bool = False,
+    ) -> "Consensus":
+        self = cls()
+        parameters.log()
+
+        tx_consensus: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_loopback: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_proposer: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_helper: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+
+        address = committee.address(name)
+        assert address is not None, "our public key is not in the committee"
+        self.receivers.append(
+            await Receiver.spawn(
+                ("0.0.0.0", address[1]),
+                ConsensusReceiverHandler(tx_consensus, tx_helper),
+            )
+        )
+        log.info("Node %s listening to consensus messages on %s", name, address)
+
+        leader_elector = LeaderElector(committee)
+        self.mempool_driver = MempoolDriver(store, tx_mempool, tx_loopback)
+        self.synchronizer = Synchronizer(
+            name, committee, store, tx_loopback, parameters.sync_retry_delay
+        )
+
+        self.tasks.append(
+            Core.spawn(
+                name,
+                committee,
+                signature_service,
+                store,
+                leader_elector,
+                self.mempool_driver,
+                self.synchronizer,
+                parameters.timeout_delay,
+                tx_consensus,
+                tx_loopback,
+                tx_proposer,
+                tx_commit,
+                benchmark=benchmark,
+            )
+        )
+        self.tasks.append(
+            Proposer.spawn(
+                name,
+                committee,
+                signature_service,
+                rx_mempool,
+                tx_proposer,
+                tx_loopback,
+                benchmark=benchmark,
+            )
+        )
+        self.tasks.append(Helper.spawn(committee, store, tx_helper))
+        return self
+
+    async def shutdown(self) -> None:
+        for t in self.tasks:
+            t.cancel()
+        if self.synchronizer is not None:
+            self.synchronizer.shutdown()
+        if self.mempool_driver is not None:
+            self.mempool_driver.shutdown()
+        for r in self.receivers:
+            await r.shutdown()

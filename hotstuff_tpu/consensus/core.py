@@ -1,0 +1,340 @@
+"""The 2-chain HotStuff protocol state machine (reference
+``consensus/src/core.rs``).
+
+State: round, last_voted_round, last_committed_round, high_qc, timer,
+aggregator. Voting safety rules (``core.rs:99-116``):
+
+- rule 1: ``block.round > last_voted_round``
+- rule 2: ``block.qc.round + 1 == block.round`` OR the block extends a TC
+  (``tc.round + 1 == block.round`` and ``block.qc.round >= max(tc.high_qc_rounds)``)
+
+2-chain commit rule (``core.rs:331-336``): when ``b0.round + 1 == b1.round``
+for the chain ``b0 <- |qc0; b1| <- |qc1; block|``, commit ``b0`` and all its
+uncommitted ancestors.
+
+Crash-safety improvement over the reference: the voting state
+(``last_voted_round``, ``round``, ``high_qc``) is persisted to the store
+before each vote/timeout signature, fixing the reference's acknowledged
+unsafe-recovery TODO (``core.rs:114``, issue #15).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hotstuff_tpu.crypto import PublicKey, SignatureService
+from hotstuff_tpu.network import SimpleSender
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.serde import Decoder, Encoder
+
+from .aggregator import Aggregator
+from .config import Committee, Round
+from .errors import ConsensusError, WrongLeader
+from .leader import LeaderElector
+from .mempool_driver import MempoolDriver
+from .messages import (
+    QC,
+    TC,
+    Block,
+    Timeout,
+    Vote,
+    encode_tc,
+    encode_timeout,
+    encode_vote,
+)
+from .proposer import Cleanup as ProposerCleanup
+from .proposer import Make as ProposerMake
+from .synchronizer import Synchronizer
+from .timer import Timer
+
+log = logging.getLogger("consensus")
+
+_STATE_KEY = b"__consensus_state__"
+
+
+class Core:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        signature_service: SignatureService,
+        store: Store,
+        leader_elector: LeaderElector,
+        mempool_driver: MempoolDriver,
+        synchronizer: Synchronizer,
+        timeout_delay: int,
+        rx_message: asyncio.Queue,
+        rx_loopback: asyncio.Queue,
+        tx_proposer: asyncio.Queue,
+        tx_commit: asyncio.Queue,
+        benchmark: bool = False,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.signature_service = signature_service
+        self.store = store
+        self.leader_elector = leader_elector
+        self.mempool_driver = mempool_driver
+        self.synchronizer = synchronizer
+        self.rx_message = rx_message
+        self.rx_loopback = rx_loopback
+        self.tx_proposer = tx_proposer
+        self.tx_commit = tx_commit
+        self.benchmark = benchmark
+        self.round: Round = 1
+        self.last_voted_round: Round = 0
+        self.last_committed_round: Round = 0
+        self.high_qc = QC.genesis()
+        self.timer = Timer(timeout_delay)
+        self.aggregator = Aggregator(committee)
+        self.network = SimpleSender()
+
+    @classmethod
+    def spawn(cls, *args, **kwargs) -> asyncio.Task:
+        self = cls(*args, **kwargs)
+        return asyncio.create_task(self.run(), name="consensus_core")
+
+    # -- persistence of voting state (fixes reference issue #15) ------------
+
+    async def _persist_state(self) -> None:
+        enc = Encoder()
+        enc.u64(self.round).u64(self.last_voted_round).u64(self.last_committed_round)
+        self.high_qc.encode(enc)
+        await self.store.write(_STATE_KEY, enc.finish())
+
+    async def _restore_state(self) -> None:
+        data = await self.store.read(_STATE_KEY)
+        if data is None:
+            return
+        try:
+            dec = Decoder(data)
+            self.round = dec.u64()
+            self.last_voted_round = dec.u64()
+            self.last_committed_round = dec.u64()
+            self.high_qc = QC.decode(dec)
+            dec.finish()
+            log.info(
+                "Restored consensus state: round %d, last_voted %d",
+                self.round,
+                self.last_voted_round,
+            )
+        except Exception as e:  # corrupt state: safer to halt than equivocate
+            raise ConsensusError(f"corrupt persisted consensus state: {e}") from e
+
+    # -- helpers ------------------------------------------------------------
+
+    async def store_block(self, block: Block) -> None:
+        await self.store.write(block.digest().data, block.serialize())
+
+    def increase_last_voted_round(self, target: Round) -> None:
+        self.last_voted_round = max(self.last_voted_round, target)
+
+    async def make_vote(self, block: Block) -> Vote | None:
+        safety_rule_1 = block.round > self.last_voted_round
+        safety_rule_2 = block.qc.round + 1 == block.round
+        if block.tc is not None:
+            can_extend = block.tc.round + 1 == block.round
+            can_extend &= block.qc.round >= max(block.tc.high_qc_rounds())
+            safety_rule_2 |= can_extend
+        if not (safety_rule_1 and safety_rule_2):
+            return None
+        # Ensure we won't vote for contradicting blocks: persist BEFORE the
+        # vote leaves this process.
+        self.increase_last_voted_round(block.round)
+        await self._persist_state()
+        return await Vote.new(block, self.name, self.signature_service)
+
+    async def commit(self, block: Block) -> None:
+        if self.last_committed_round >= block.round:
+            return
+        # Commit the entire chain (needed after view-changes).
+        to_commit = [block]
+        parent = block
+        while self.last_committed_round + 1 < parent.round:
+            ancestor = await self.synchronizer.get_parent_block(parent)
+            assert ancestor is not None, "committed block should have all ancestors"
+            to_commit.append(ancestor)
+            parent = ancestor
+        self.last_committed_round = block.round
+
+        for blk in reversed(to_commit):
+            if blk.payload:
+                log.info("Committed %s", blk)
+                if self.benchmark:
+                    for d in blk.payload:
+                        # NOTE: benchmark measurement interface (reference
+                        # ``core.rs:145-149``).
+                        log.info("Committed %s -> %s", blk, d)
+            log.debug("Committed %r", blk)
+            await self.tx_commit.put(blk)
+
+    def update_high_qc(self, qc: QC) -> None:
+        if qc.round > self.high_qc.round:
+            self.high_qc = qc
+
+    async def local_timeout_round(self) -> None:
+        log.warning("Timeout reached for round %d", self.round)
+        self.increase_last_voted_round(self.round)
+        await self._persist_state()
+        timeout = await Timeout.new(
+            self.high_qc, self.round, self.name, self.signature_service
+        )
+        log.debug("Created %r", timeout)
+        self.timer.reset()
+        addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
+        self.network.broadcast(addresses, encode_timeout(timeout))
+        await self.handle_timeout(timeout)
+
+    # -- handlers -----------------------------------------------------------
+
+    async def handle_vote(self, vote: Vote) -> None:
+        log.debug("Processing %r", vote)
+        if vote.round < self.round:
+            return
+        vote.verify(self.committee)
+        qc = self.aggregator.add_vote(vote)
+        if qc is not None:
+            log.debug("Assembled %r", qc)
+            await self.process_qc(qc)
+            if self.name == self.leader_elector.get_leader(self.round):
+                await self.generate_proposal(None)
+
+    async def handle_timeout(self, timeout: Timeout) -> None:
+        log.debug("Processing %r", timeout)
+        if timeout.round < self.round:
+            return
+        timeout.verify(self.committee)
+        await self.process_qc(timeout.high_qc)
+        tc = self.aggregator.add_timeout(timeout)
+        if tc is not None:
+            log.debug("Assembled %r", tc)
+            await self.advance_round(tc.round)
+            addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
+            self.network.broadcast(addresses, encode_tc(tc))
+            if self.name == self.leader_elector.get_leader(self.round):
+                await self.generate_proposal(tc)
+
+    async def advance_round(self, round_: Round) -> None:
+        if round_ < self.round:
+            return
+        self.timer.reset()
+        self.round = round_ + 1
+        log.debug("Moved to round %d", self.round)
+        self.aggregator.cleanup(self.round)
+
+    async def generate_proposal(self, tc: TC | None) -> None:
+        await self.tx_proposer.put(ProposerMake(self.round, self.high_qc, tc))
+
+    async def cleanup_proposer(self, b0: Block, b1: Block, block: Block) -> None:
+        digests = [*b0.payload, *b1.payload, *block.payload]
+        await self.tx_proposer.put(ProposerCleanup(digests))
+
+    async def process_qc(self, qc: QC) -> None:
+        await self.advance_round(qc.round)
+        self.update_high_qc(qc)
+
+    async def process_block(self, block: Block) -> None:
+        log.debug("Processing %r", block)
+        # We need the two ancestors b0 <- |qc0; b1| <- |qc1; block|; if any is
+        # missing the synchronizer fetches them and re-injects this block.
+        ancestors = await self.synchronizer.get_ancestors(block)
+        if ancestors is None:
+            log.debug("Processing of %r suspended: missing parent", block.digest())
+            return
+        b0, b1 = ancestors
+
+        # Store only blocks whose full ancestry we have processed.
+        await self.store_block(block)
+        await self.cleanup_proposer(b0, b1, block)
+
+        # 2-chain commit rule.
+        if b0.round + 1 == b1.round:
+            await self.mempool_driver.cleanup(b0.round)
+            await self.commit(b0)
+
+        # Round guard: prevents bad leaders from dragging us far into the
+        # future (reference ``core.rs:345-349``).
+        if block.round != self.round:
+            return
+
+        vote = await self.make_vote(block)
+        if vote is not None:
+            log.debug("Created %r", vote)
+            next_leader = self.leader_elector.get_leader(self.round + 1)
+            if next_leader == self.name:
+                await self.handle_vote(vote)
+            else:
+                address = self.committee.address(next_leader)
+                assert address is not None, "next leader not in committee"
+                self.network.send(address, encode_vote(vote))
+
+    async def handle_proposal(self, block: Block) -> None:
+        digest = block.digest()
+        if block.author != self.leader_elector.get_leader(block.round):
+            raise WrongLeader(
+                f"block {digest} from {block.author} at round {block.round}"
+            )
+        block.verify(self.committee)
+        await self.process_qc(block.qc)
+        if block.tc is not None:
+            await self.advance_round(block.tc.round)
+        if not await self.mempool_driver.verify(block):
+            log.debug("Processing of %r suspended: missing payload", digest)
+            return
+        await self.process_block(block)
+
+    async def handle_tc(self, tc: TC) -> None:
+        tc.verify(self.committee)
+        if tc.round < self.round:
+            return
+        await self.advance_round(tc.round)
+        if self.name == self.leader_elector.get_leader(self.round):
+            await self.generate_proposal(tc)
+
+    # -- main loop ----------------------------------------------------------
+
+    async def run(self) -> None:
+        await self._restore_state()
+        self.timer.reset()
+        if self.name == self.leader_elector.get_leader(self.round):
+            await self.generate_proposal(None)
+
+        get_message = asyncio.create_task(self.rx_message.get())
+        get_loopback = asyncio.create_task(self.rx_loopback.get())
+        timer_wait = asyncio.create_task(self.timer.wait())
+        while True:
+            done, _ = await asyncio.wait(
+                {get_message, get_loopback, timer_wait},
+                return_when=asyncio.FIRST_COMPLETED,
+            )
+            if get_message in done:
+                kind, payload = get_message.result()
+                get_message = asyncio.create_task(self.rx_message.get())
+                handlers = {
+                    "propose": self.handle_proposal,
+                    "vote": self.handle_vote,
+                    "timeout": self.handle_timeout,
+                    "tc": self.handle_tc,
+                }
+                handler = handlers.get(kind)
+                if handler is None:
+                    log.error("unexpected protocol message kind %s", kind)
+                else:
+                    await self._guarded(handler(payload))
+            if get_loopback in done:
+                block = get_loopback.result()
+                get_loopback = asyncio.create_task(self.rx_loopback.get())
+                await self._guarded(self.process_block(block))
+            if timer_wait in done:
+                timer_wait.result()
+                timer_wait = asyncio.create_task(self.timer.wait())
+                await self._guarded(self.local_timeout_round())
+
+    async def _guarded(self, coro) -> None:
+        """Protocol errors (byzantine input) are logged, never fatal
+        (reference ``core.rs:434-440``)."""
+        try:
+            await coro
+        except ConsensusError as e:
+            log.warning("%s: %s", type(e).__name__, e)

@@ -1,0 +1,35 @@
+"""Consensus error types (reference ``consensus/src/error.rs:25-65``)."""
+
+from __future__ import annotations
+
+
+class ConsensusError(Exception):
+    pass
+
+
+class WrongLeader(ConsensusError):
+    pass
+
+
+class UnknownAuthority(ConsensusError):
+    pass
+
+
+class AuthorityReuse(ConsensusError):
+    pass
+
+
+class QCRequiresQuorum(ConsensusError):
+    pass
+
+
+class TCRequiresQuorum(ConsensusError):
+    pass
+
+
+class InvalidSignature(ConsensusError):
+    pass
+
+
+class MalformedMessage(ConsensusError):
+    pass

@@ -1,0 +1,38 @@
+"""Consensus helper: serve peers' ``SyncRequest``s — read the block from the
+store and reply with a full ``Propose`` message so it flows the requester's
+normal proposal path (reference ``consensus/src/helper.rs:26-68``)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hotstuff_tpu.network import SimpleSender
+from hotstuff_tpu.store import Store
+
+from .config import Committee
+from .messages import Block, encode_propose
+
+log = logging.getLogger("consensus")
+
+
+class Helper:
+    @classmethod
+    def spawn(
+        cls, committee: Committee, store: Store, rx_request: asyncio.Queue
+    ) -> asyncio.Task:
+        network = SimpleSender()
+
+        async def run():
+            while True:
+                digest, origin = await rx_request.get()
+                address = committee.address(origin)
+                if address is None:
+                    log.warning("received sync request from unknown node %s", origin)
+                    continue
+                data = await store.read(digest.data)
+                if data is not None:
+                    block = Block.deserialize(data)
+                    network.send(address, encode_propose(block))
+
+        return asyncio.create_task(run(), name="consensus_helper")

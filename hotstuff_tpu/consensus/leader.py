@@ -1,0 +1,20 @@
+"""Round-robin leader election over sorted public keys (reference
+``consensus/src/leader.rs:16-20``)."""
+
+from __future__ import annotations
+
+from hotstuff_tpu.crypto import PublicKey
+
+from .config import Committee, Round
+
+
+class RRLeaderElector:
+    def __init__(self, committee: Committee) -> None:
+        self.committee = committee
+        self._sorted = committee.sorted_keys()
+
+    def get_leader(self, round_: Round) -> PublicKey:
+        return self._sorted[round_ % len(self._sorted)]
+
+
+LeaderElector = RRLeaderElector

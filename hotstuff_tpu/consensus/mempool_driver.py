@@ -1,0 +1,68 @@
+"""Payload availability gate (reference ``consensus/src/mempool.rs``).
+
+``verify(block)`` checks every payload digest is in the store; when batches
+are missing it sends ``Synchronize`` to the mempool and parks the block in
+the PayloadWaiter, which re-injects it to the Core once all batches arrive
+(store ``notify_read`` on each missing digest). ``cleanup(round)`` propagates
+GC to the mempool and cancels stale waiters.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hotstuff_tpu.crypto import Digest
+from hotstuff_tpu.mempool import Cleanup as MempoolCleanup
+from hotstuff_tpu.mempool import Synchronize as MempoolSynchronize
+from hotstuff_tpu.store import Store
+
+from .config import Round
+from .messages import Block
+
+log = logging.getLogger("consensus")
+
+
+class MempoolDriver:
+    def __init__(
+        self,
+        store: Store,
+        tx_mempool: asyncio.Queue,
+        tx_loopback: asyncio.Queue,
+    ) -> None:
+        self.store = store
+        self.tx_mempool = tx_mempool
+        self.tx_loopback = tx_loopback
+        # block digest -> (round, waiter task)
+        self._pending: dict[Digest, tuple[Round, asyncio.Task]] = {}
+
+    async def verify(self, block: Block) -> bool:
+        """True if all payload batches are local; otherwise triggers sync and
+        parks the block (reference ``mempool.rs:40-64``)."""
+        missing = [
+            d for d in block.payload if await self.store.read(d.data) is None
+        ]
+        if not missing:
+            return True
+        await self.tx_mempool.put(MempoolSynchronize(missing, block.author))
+        digest = block.digest()
+        if digest not in self._pending:
+            task = asyncio.create_task(self._waiter(missing, block))
+            self._pending[digest] = (block.round, task)
+        return False
+
+    async def _waiter(self, missing: list[Digest], block: Block) -> None:
+        await asyncio.gather(*[self.store.notify_read(d.data) for d in missing])
+        self._pending.pop(block.digest(), None)
+        await self.tx_loopback.put(block)
+
+    async def cleanup(self, round_: Round) -> None:
+        await self.tx_mempool.put(MempoolCleanup(round_))
+        stale = [d for d, (r, _) in self._pending.items() if r <= round_]
+        for d in stale:
+            _, task = self._pending.pop(d)
+            task.cancel()
+
+    def shutdown(self) -> None:
+        for _, task in self._pending.values():
+            task.cancel()

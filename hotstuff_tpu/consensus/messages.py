@@ -1,0 +1,424 @@
+"""Consensus messages: Block, Vote, QC, Timeout, TC (reference
+``consensus/src/messages.rs``).
+
+Digest definitions mirror the reference exactly (SHA-512 truncated to 32 B):
+
+- ``Block``: H(author ‖ round_le ‖ payload... ‖ qc.hash)  (``messages.rs:79-90``)
+- ``Vote``/``QC``: H(block_hash ‖ round_le)               (``messages.rs:150-162,200-212``)
+- ``Timeout``: H(round_le ‖ high_qc.round_le)             (``messages.rs:267-279``)
+- ``TC`` per-voter digest: H(tc.round_le ‖ high_qc_round_le) (``messages.rs:303-314``)
+
+``QC.verify`` batches all 2f+1 vote signatures into one
+``Signature.verify_batch`` call — the TPU offload site (``messages.rs:180-198``).
+"""
+
+from __future__ import annotations
+
+import struct
+from dataclasses import dataclass
+
+from hotstuff_tpu.crypto import (
+    CryptoError,
+    Digest,
+    PublicKey,
+    SecretKey,
+    Signature,
+    sha512_digest,
+)
+from hotstuff_tpu.utils.serde import Decoder, Encoder
+
+from . import errors
+from .config import Committee, Round
+
+_U64 = struct.Struct("<Q")
+
+
+# ---------------------------------------------------------------------------
+# QC
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class QC:
+    hash: Digest
+    round: Round
+    votes: list[tuple[PublicKey, Signature]]
+
+    @classmethod
+    def genesis(cls) -> "QC":
+        return cls(hash=Digest.default(), round=0, votes=[])
+
+    def digest(self) -> Digest:
+        return sha512_digest(self.hash.data, _U64.pack(self.round))
+
+    def __eq__(self, other) -> bool:
+        # Vote-set-independent equality (reference ``messages.rs:214-218``).
+        return (
+            isinstance(other, QC)
+            and self.hash == other.hash
+            and self.round == other.round
+        )
+
+    def verify(self, committee: Committee) -> None:
+        """Stake/duplicate accounting, then batch-verify all vote signatures
+        (reference ``messages.rs:180-198``)."""
+        weight = 0
+        used = set()
+        for name, _ in self.votes:
+            if name in used:
+                raise errors.AuthorityReuse(str(name))
+            stake = committee.stake(name)
+            if stake == 0:
+                raise errors.UnknownAuthority(str(name))
+            used.add(name)
+            weight += stake
+        if weight < committee.quorum_threshold():
+            raise errors.QCRequiresQuorum("QC requires a quorum")
+        try:
+            Signature.verify_batch(self.digest(), self.votes)
+        except CryptoError as e:
+            raise errors.InvalidSignature(str(e)) from e
+
+    def encode(self, enc: Encoder) -> None:
+        enc.raw(self.hash.data).u64(self.round).seq(
+            self.votes, lambda e, v: e.raw(v[0].data).raw(v[1].data)
+        )
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "QC":
+        h = Digest(dec.raw(32))
+        rnd = dec.u64()
+        votes = dec.seq(lambda d: (PublicKey(d.raw(32)), Signature(d.raw(64))))
+        return cls(h, rnd, votes)
+
+    def __repr__(self) -> str:
+        return f"QC({self.hash!r}, {self.round})"
+
+
+# ---------------------------------------------------------------------------
+# TC
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class TC:
+    round: Round
+    votes: list[tuple[PublicKey, Signature, Round]]  # (author, sig, high_qc_round)
+
+    def high_qc_rounds(self) -> list[Round]:
+        return [r for _, _, r in self.votes]
+
+    def verify(self, committee: Committee) -> None:
+        """Stake accounting, then verify per-voter digests — batched through
+        the backend's multi-message path (reference ``messages.rs:283-320``
+        verifies sig-by-sig; we keep identical acceptance but one device
+        call)."""
+        weight = 0
+        used = set()
+        for name, _, _ in self.votes:
+            if name in used:
+                raise errors.AuthorityReuse(str(name))
+            stake = committee.stake(name)
+            if stake == 0:
+                raise errors.UnknownAuthority(str(name))
+            used.add(name)
+            weight += stake
+        if weight < committee.quorum_threshold():
+            raise errors.TCRequiresQuorum("TC requires a quorum")
+        try:
+            Signature.verify_batch_multi(
+                [
+                    (
+                        sha512_digest(_U64.pack(self.round), _U64.pack(hqc_round)),
+                        author,
+                        sig,
+                    )
+                    for author, sig, hqc_round in self.votes
+                ]
+            )
+        except CryptoError as e:
+            raise errors.InvalidSignature(str(e)) from e
+
+    def encode(self, enc: Encoder) -> None:
+        enc.u64(self.round).seq(
+            self.votes, lambda e, v: e.raw(v[0].data).raw(v[1].data).u64(v[2])
+        )
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "TC":
+        rnd = dec.u64()
+        votes = dec.seq(
+            lambda d: (PublicKey(d.raw(32)), Signature(d.raw(64)), d.u64())
+        )
+        return cls(rnd, votes)
+
+    def __repr__(self) -> str:
+        return f"TC({self.round}, {self.high_qc_rounds()})"
+
+
+# ---------------------------------------------------------------------------
+# Block
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Block:
+    qc: QC
+    tc: TC | None
+    author: PublicKey
+    round: Round
+    payload: list[Digest]
+    signature: Signature
+
+    @classmethod
+    def genesis(cls) -> "Block":
+        return cls(
+            qc=QC.genesis(),
+            tc=None,
+            author=PublicKey(bytes(32)),
+            round=0,
+            payload=[],
+            signature=Signature.default(),
+        )
+
+    @classmethod
+    async def new(cls, qc, tc, author, round_, payload, signature_service) -> "Block":
+        block = cls(qc, tc, author, round_, payload, Signature.default())
+        block.signature = await signature_service.request_signature(block.digest())
+        return block
+
+    @classmethod
+    def new_from_key(cls, qc, tc, author, round_, payload, secret: SecretKey) -> "Block":
+        """Synchronous test constructor (reference
+        ``consensus/src/tests/common.rs:48-114``)."""
+        block = cls(qc, tc, author, round_, payload, Signature.default())
+        block.signature = Signature.new(block.digest(), secret)
+        return block
+
+    def parent(self) -> Digest:
+        return self.qc.hash
+
+    def digest(self) -> Digest:
+        return sha512_digest(
+            self.author.data,
+            _U64.pack(self.round),
+            *[d.data for d in self.payload],
+            self.qc.hash.data,
+        )
+
+    def verify(self, committee: Committee) -> None:
+        """Author stake + signature + embedded QC/TC (reference
+        ``messages.rs:55-76``)."""
+        if committee.stake(self.author) == 0:
+            raise errors.UnknownAuthority(str(self.author))
+        try:
+            self.signature.verify(self.digest(), self.author)
+        except CryptoError as e:
+            raise errors.InvalidSignature(str(e)) from e
+        if self.qc != QC.genesis():
+            self.qc.verify(committee)
+        if self.tc is not None:
+            self.tc.verify(committee)
+
+    def encode(self, enc: Encoder) -> None:
+        self.qc.encode(enc)
+        enc.option(self.tc, lambda e, tc: tc.encode(e))
+        enc.raw(self.author.data).u64(self.round)
+        enc.seq(self.payload, lambda e, d: e.raw(d.data))
+        enc.raw(self.signature.data)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Block":
+        qc = QC.decode(dec)
+        tc = dec.option(TC.decode)
+        author = PublicKey(dec.raw(32))
+        rnd = dec.u64()
+        payload = dec.seq(lambda d: Digest(d.raw(32)))
+        sig = Signature(dec.raw(64))
+        return cls(qc, tc, author, rnd, payload, sig)
+
+    def serialize(self) -> bytes:
+        """Standalone encoding — the form blocks are stored under in the
+        store (reference ``core.rs:89-93``)."""
+        enc = Encoder()
+        self.encode(enc)
+        return enc.finish()
+
+    @classmethod
+    def deserialize(cls, data: bytes) -> "Block":
+        dec = Decoder(data)
+        block = cls.decode(dec)
+        dec.finish()
+        return block
+
+    def __str__(self) -> str:
+        return f"B{self.round}"
+
+    def __repr__(self) -> str:
+        return (
+            f"{self.digest()!r}: B({self.author!r}, {self.round}, "
+            f"{self.qc!r}, {len(self.payload) * 32})"
+        )
+
+
+# ---------------------------------------------------------------------------
+# Vote
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Vote:
+    hash: Digest
+    round: Round
+    author: PublicKey
+    signature: Signature
+
+    @classmethod
+    async def new(cls, block: Block, author, signature_service) -> "Vote":
+        vote = cls(block.digest(), block.round, author, Signature.default())
+        vote.signature = await signature_service.request_signature(vote.digest())
+        return vote
+
+    @classmethod
+    def new_from_key(cls, hash_: Digest, round_: Round, author, secret) -> "Vote":
+        vote = cls(hash_, round_, author, Signature.default())
+        vote.signature = Signature.new(vote.digest(), secret)
+        return vote
+
+    def digest(self) -> Digest:
+        return sha512_digest(self.hash.data, _U64.pack(self.round))
+
+    def verify(self, committee: Committee) -> None:
+        if committee.stake(self.author) == 0:
+            raise errors.UnknownAuthority(str(self.author))
+        try:
+            self.signature.verify(self.digest(), self.author)
+        except CryptoError as e:
+            raise errors.InvalidSignature(str(e)) from e
+
+    def encode(self, enc: Encoder) -> None:
+        enc.raw(self.hash.data).u64(self.round).raw(self.author.data).raw(
+            self.signature.data
+        )
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Vote":
+        return cls(
+            Digest(dec.raw(32)),
+            dec.u64(),
+            PublicKey(dec.raw(32)),
+            Signature(dec.raw(64)),
+        )
+
+    def __repr__(self) -> str:
+        return f"V({self.author!r}, {self.round}, {self.hash!r})"
+
+
+# ---------------------------------------------------------------------------
+# Timeout
+# ---------------------------------------------------------------------------
+
+
+@dataclass
+class Timeout:
+    high_qc: QC
+    round: Round
+    author: PublicKey
+    signature: Signature
+
+    @classmethod
+    async def new(cls, high_qc, round_, author, signature_service) -> "Timeout":
+        t = cls(high_qc, round_, author, Signature.default())
+        t.signature = await signature_service.request_signature(t.digest())
+        return t
+
+    @classmethod
+    def new_from_key(cls, high_qc, round_, author, secret) -> "Timeout":
+        t = cls(high_qc, round_, author, Signature.default())
+        t.signature = Signature.new(t.digest(), secret)
+        return t
+
+    def digest(self) -> Digest:
+        return sha512_digest(_U64.pack(self.round), _U64.pack(self.high_qc.round))
+
+    def verify(self, committee: Committee) -> None:
+        if committee.stake(self.author) == 0:
+            raise errors.UnknownAuthority(str(self.author))
+        try:
+            self.signature.verify(self.digest(), self.author)
+        except CryptoError as e:
+            raise errors.InvalidSignature(str(e)) from e
+        if self.high_qc != QC.genesis():
+            self.high_qc.verify(committee)
+
+    def encode(self, enc: Encoder) -> None:
+        self.high_qc.encode(enc)
+        enc.u64(self.round).raw(self.author.data).raw(self.signature.data)
+
+    @classmethod
+    def decode(cls, dec: Decoder) -> "Timeout":
+        return cls(
+            QC.decode(dec), dec.u64(), PublicKey(dec.raw(32)), Signature(dec.raw(64))
+        )
+
+    def __repr__(self) -> str:
+        return f"TV({self.author!r}, {self.round}, {self.high_qc!r})"
+
+
+# ---------------------------------------------------------------------------
+# Wire envelope: ConsensusMessage (reference ``consensus.rs:32-39``).
+# ---------------------------------------------------------------------------
+
+TAG_PROPOSE = 0
+TAG_VOTE = 1
+TAG_TIMEOUT = 2
+TAG_TC = 3
+TAG_SYNC_REQUEST = 4
+
+
+def encode_propose(block: Block) -> bytes:
+    enc = Encoder().u8(TAG_PROPOSE)
+    block.encode(enc)
+    return enc.finish()
+
+
+def encode_vote(vote: Vote) -> bytes:
+    enc = Encoder().u8(TAG_VOTE)
+    vote.encode(enc)
+    return enc.finish()
+
+
+def encode_timeout(timeout: Timeout) -> bytes:
+    enc = Encoder().u8(TAG_TIMEOUT)
+    timeout.encode(enc)
+    return enc.finish()
+
+
+def encode_tc(tc: TC) -> bytes:
+    enc = Encoder().u8(TAG_TC)
+    tc.encode(enc)
+    return enc.finish()
+
+
+def encode_sync_request(missing: Digest, origin: PublicKey) -> bytes:
+    return Encoder().u8(TAG_SYNC_REQUEST).raw(missing.data).raw(origin.data).finish()
+
+
+def decode_message(data: bytes):
+    """Returns (kind, payload). Raises on malformed/byzantine input."""
+    dec = Decoder(data)
+    tag = dec.u8()
+    if tag == TAG_PROPOSE:
+        out = ("propose", Block.decode(dec))
+    elif tag == TAG_VOTE:
+        out = ("vote", Vote.decode(dec))
+    elif tag == TAG_TIMEOUT:
+        out = ("timeout", Timeout.decode(dec))
+    elif tag == TAG_TC:
+        out = ("tc", TC.decode(dec))
+    elif tag == TAG_SYNC_REQUEST:
+        out = ("sync_request", (Digest(dec.raw(32)), PublicKey(dec.raw(32))))
+    else:
+        raise errors.MalformedMessage(f"unknown consensus tag {tag}")
+    dec.finish()
+    return out

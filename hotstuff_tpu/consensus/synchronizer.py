@@ -1,0 +1,119 @@
+"""Consensus block-ancestry synchronizer (reference
+``consensus/src/synchronizer.rs``).
+
+``get_parent_block`` reads the store or fires a ``SyncRequest`` to the block
+author and suspends processing; an inner task waits on store ``notify_read``
+and loops delivered blocks back to the Core. A coarse timer re-broadcasts
+expired requests to all peers ("perfect point-to-point link",
+``synchronizer.rs:84-105``).
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+import time
+
+from hotstuff_tpu.crypto import Digest, PublicKey
+from hotstuff_tpu.network import SimpleSender
+from hotstuff_tpu.store import Store
+
+from .config import Committee
+from .messages import Block, QC, encode_sync_request
+
+log = logging.getLogger("consensus")
+
+TIMER_ACCURACY = 5.0  # s (reference ``synchronizer.rs:22``)
+CHANNEL_CAPACITY = 1_000
+
+
+class Synchronizer:
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        store: Store,
+        tx_loopback: asyncio.Queue,
+        sync_retry_delay: int,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.store = store
+        self.tx_loopback = tx_loopback
+        self.sync_retry_delay = sync_retry_delay / 1000.0
+        self.network = SimpleSender()
+        self._inner: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        self._pending: set[Digest] = set()  # block digests being waited on
+        self._requests: dict[Digest, float] = {}  # parent digest -> first-request ts
+        self._tasks: set[asyncio.Task] = set()
+        self._main = asyncio.create_task(self._run(), name="consensus_synchronizer")
+
+    async def _waiter(self, wait_on: Digest, deliver: Block) -> None:
+        await self.store.notify_read(wait_on.data)
+        self._pending.discard(deliver.digest())
+        self._requests.pop(deliver.parent(), None)
+        await self.tx_loopback.put(deliver)
+
+    async def _run(self) -> None:
+        get_block = asyncio.create_task(self._inner.get())
+        timer = asyncio.create_task(asyncio.sleep(TIMER_ACCURACY))
+        while True:
+            done, _ = await asyncio.wait(
+                {get_block, timer}, return_when=asyncio.FIRST_COMPLETED
+            )
+            if get_block in done:
+                block: Block = get_block.result()
+                get_block = asyncio.create_task(self._inner.get())
+                digest = block.digest()
+                if digest not in self._pending:
+                    self._pending.add(digest)
+                    parent = block.parent()
+                    task = asyncio.create_task(self._waiter(parent, block))
+                    self._tasks.add(task)
+                    task.add_done_callback(self._tasks.discard)
+                    if parent not in self._requests:
+                        log.debug("requesting sync for block %s", parent)
+                        self._requests[parent] = time.monotonic()
+                        address = self.committee.address(block.author)
+                        if address is not None:
+                            self.network.send(
+                                address, encode_sync_request(parent, self.name)
+                            )
+            if timer in done:
+                timer = asyncio.create_task(asyncio.sleep(TIMER_ACCURACY))
+                now = time.monotonic()
+                addresses = [
+                    a for _, a in self.committee.broadcast_addresses(self.name)
+                ]
+                for digest, ts in self._requests.items():
+                    if ts + self.sync_retry_delay < now:
+                        log.debug("requesting sync for block %s (retry)", digest)
+                        self.network.broadcast(
+                            addresses, encode_sync_request(digest, self.name)
+                        )
+
+    async def get_parent_block(self, block: Block) -> Block | None:
+        """The parent if stored; None after scheduling a sync (reference
+        ``synchronizer.rs:120-134``)."""
+        if block.qc == QC.genesis():
+            return Block.genesis()
+        data = await self.store.read(block.parent().data)
+        if data is not None:
+            return Block.deserialize(data)
+        await self._inner.put(block)
+        return None
+
+    async def get_ancestors(self, block: Block) -> tuple[Block, Block] | None:
+        """(b0, b1) where b0 <- |qc0; b1| <- |qc1; block|, or None if the
+        chain is incomplete (reference ``synchronizer.rs:136-149``)."""
+        b1 = await self.get_parent_block(block)
+        if b1 is None:
+            return None
+        b0 = await self.get_parent_block(b1)
+        assert b0 is not None, "we should have all ancestors of delivered blocks"
+        return (b0, b1)
+
+    def shutdown(self) -> None:
+        self._main.cancel()
+        for t in self._tasks:
+            t.cancel()

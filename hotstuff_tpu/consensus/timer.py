@@ -1,0 +1,27 @@
+"""Resettable timeout timer (reference ``consensus/src/timer.rs:10-34``).
+
+``wait()`` completes when the deadline passes; ``reset()`` pushes the
+deadline forward — an in-flight ``wait()`` observes the new deadline and
+keeps sleeping, matching the reference's resettable ``Sleep``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import time
+
+
+class Timer:
+    def __init__(self, duration_ms: int) -> None:
+        self.duration = duration_ms / 1000.0
+        self._deadline = time.monotonic() + self.duration
+
+    def reset(self) -> None:
+        self._deadline = time.monotonic() + self.duration
+
+    async def wait(self) -> None:
+        while True:
+            remaining = self._deadline - time.monotonic()
+            if remaining <= 0:
+                return
+            await asyncio.sleep(remaining)

@@ -1,0 +1,20 @@
+"""Mempool layer: batch dissemination ahead of consensus (reference
+``mempool/src/mempool.rs``).
+
+Data-plane/control-plane split: bulk transaction data travels
+mempool-to-mempool as batches; consensus orders only 32-byte digests
+(reference ``batch_maker.rs:100-155``, ``consensus/src/messages.rs:22``).
+"""
+
+from .config import Authority, Committee, Parameters
+from .mempool import Mempool
+from .synchronizer import Cleanup, Synchronize
+
+__all__ = [
+    "Authority",
+    "Committee",
+    "Parameters",
+    "Mempool",
+    "Synchronize",
+    "Cleanup",
+]

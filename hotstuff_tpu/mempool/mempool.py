@@ -1,0 +1,163 @@
+"""Mempool root: wires all mempool actors and network receivers (reference
+``mempool/src/mempool.rs:58-245``).
+
+Two receivers: client transactions on ``transactions_address`` and peer
+messages on ``mempool_address`` (both rebound to 0.0.0.0, reference
+``mempool.rs:119,166``). Peer ``Batch`` messages are ACKed then routed to a
+Processor; ``BatchRequest``s go to the Helper.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hotstuff_tpu.crypto import PublicKey
+from hotstuff_tpu.network import MessageHandler, Receiver
+from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.serde import SerdeError
+
+from . import messages
+from .batch_maker import BatchMaker
+from .config import Committee, Parameters
+from .helper import Helper
+from .processor import Processor
+from .quorum_waiter import QuorumWaiter
+from .synchronizer import Synchronizer
+
+log = logging.getLogger("mempool")
+
+CHANNEL_CAPACITY = 1_000
+
+
+class TxReceiverHandler(MessageHandler):
+    """Client transactions: one-way, no ACK (reference ``mempool.rs:196-214``)."""
+
+    def __init__(self, tx_batch_maker: asyncio.Queue) -> None:
+        self.tx_batch_maker = tx_batch_maker
+
+    async def dispatch(self, writer, message: bytes) -> None:
+        await self.tx_batch_maker.put(message)
+
+
+class MempoolReceiverHandler(MessageHandler):
+    """Peer messages: ACK batches then route (reference ``mempool.rs:217-245``)."""
+
+    def __init__(self, tx_processor: asyncio.Queue, tx_helper: asyncio.Queue) -> None:
+        self.tx_processor = tx_processor
+        self.tx_helper = tx_helper
+
+    async def dispatch(self, writer, message: bytes) -> None:
+        try:
+            kind, payload = messages.decode(message)
+        except SerdeError as e:
+            log.warning("failed to decode mempool message: %s", e)
+            return
+        if kind == "batch":
+            # ACK first so the sender stops retransmitting, then store the
+            # raw serialized message (reference ``mempool.rs:224-237``).
+            await writer.send(b"Ack")
+            await self.tx_processor.put(message)
+        else:  # batch_request
+            digests, requestor = payload
+            await self.tx_helper.put((digests, requestor))
+
+
+class Mempool:
+    """Composition root (reference ``Mempool::spawn``, ``mempool.rs:58-91``)."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        parameters: Parameters,
+        store: Store,
+        rx_consensus: asyncio.Queue,  # ConsensusMempoolMessage (Synchronize/Cleanup)
+        tx_consensus: asyncio.Queue,  # batch digests out to consensus
+        benchmark: bool = False,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.parameters = parameters
+        self.store = store
+        self.rx_consensus = rx_consensus
+        self.tx_consensus = tx_consensus
+        self.benchmark = benchmark
+        self.tasks: list[asyncio.Task] = []
+        self.receivers: list[Receiver] = []
+
+    async def spawn(self) -> "Mempool":
+        self.parameters.log()
+
+        tx_batch_maker: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_quorum_waiter: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_own_processor: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_peer_processor: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_helper: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+
+        # Mempool synchronizer answering consensus sync/cleanup commands.
+        self.tasks.append(
+            Synchronizer.spawn(
+                self.name,
+                self.committee,
+                self.store,
+                self.parameters.gc_depth,
+                self.parameters.sync_retry_delay,
+                self.parameters.sync_retry_nodes,
+                self.rx_consensus,
+            )
+        )
+
+        # Client transaction intake -> batch maker.
+        tx_address = self.committee.transactions_address(self.name)
+        assert tx_address is not None, "our key is not in the committee"
+        self.receivers.append(
+            await Receiver.spawn(
+                ("0.0.0.0", tx_address[1]), TxReceiverHandler(tx_batch_maker)
+            )
+        )
+        self.tasks.append(
+            BatchMaker.spawn(
+                self.parameters.batch_size,
+                self.parameters.max_batch_delay,
+                tx_batch_maker,
+                tx_quorum_waiter,
+                self.committee.broadcast_addresses(self.name),
+                benchmark=self.benchmark,
+            )
+        )
+        self.tasks.append(
+            QuorumWaiter.spawn(
+                self.committee, self.name, tx_quorum_waiter, tx_own_processor
+            )
+        )
+        # Own batches: hash, store, digest to consensus.
+        self.tasks.append(
+            Processor.spawn(self.store, tx_own_processor, self.tx_consensus)
+        )
+
+        # Peer messages: batches + batch requests.
+        mp_address = self.committee.mempool_address(self.name)
+        assert mp_address is not None
+        self.receivers.append(
+            await Receiver.spawn(
+                ("0.0.0.0", mp_address[1]),
+                MempoolReceiverHandler(tx_peer_processor, tx_helper),
+            )
+        )
+        # Peer batches: hash, store, digest to consensus.
+        self.tasks.append(
+            Processor.spawn(self.store, tx_peer_processor, self.tx_consensus)
+        )
+        self.tasks.append(Helper.spawn(self.committee, self.store, tx_helper))
+
+        log.info(
+            "Mempool successfully booted on %s", mp_address[0]
+        )
+        return self
+
+    async def shutdown(self) -> None:
+        for t in self.tasks:
+            t.cancel()
+        for r in self.receivers:
+            await r.shutdown()

@@ -1,0 +1,25 @@
+"""Processor: hash batches (SHA-512/32), persist them, emit the digest to
+consensus (reference ``mempool/src/processor.rs:18-38``). Spawned twice: once
+for our own quorum-ACKed batches, once for batches received from peers."""
+
+from __future__ import annotations
+
+import asyncio
+
+from hotstuff_tpu.crypto import sha512_digest
+from hotstuff_tpu.store import Store
+
+
+class Processor:
+    @classmethod
+    def spawn(
+        cls, store: Store, rx_batch: asyncio.Queue, tx_digest: asyncio.Queue
+    ) -> asyncio.Task:
+        async def run():
+            while True:
+                batch: bytes = await rx_batch.get()
+                digest = sha512_digest(batch)
+                await store.write(digest.data, batch)
+                await tx_digest.put(digest)
+
+        return asyncio.create_task(run(), name="processor")

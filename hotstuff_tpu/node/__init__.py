@@ -1,0 +1,4 @@
+from .config import Committee, ConfigError, Parameters, Secret
+from .node import Node
+
+__all__ = ["Node", "Committee", "Parameters", "Secret", "ConfigError"]

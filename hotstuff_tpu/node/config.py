@@ -1,0 +1,196 @@
+"""Node-level config files (reference ``node/src/config.rs``).
+
+JSON schemas are byte-compatible with the reference benchmark harness's
+committee/parameters/key builders (reference
+``benchmark/benchmark/config.py:33-53``), so either harness can drive either
+implementation:
+
+- committee: ``{"consensus": {"authorities": {name: {name, stake, address}},
+  "epoch"}, "mempool": {"authorities": {name: {name, stake,
+  transactions_address, mempool_address}}, "epoch"}}`` with ``ip:port``
+  strings.
+- parameters: ``{"consensus": {...}, "mempool": {...}}``
+- secret: ``{"name": <b64 pk>, "secret": <b64 seed>}``
+"""
+
+from __future__ import annotations
+
+import json
+import random
+from dataclasses import dataclass
+
+from hotstuff_tpu.consensus import Authority as ConsensusAuthority
+from hotstuff_tpu.consensus import Committee as ConsensusCommittee
+from hotstuff_tpu.consensus import Parameters as ConsensusParameters
+from hotstuff_tpu.crypto import PublicKey, SecretKey, generate_keypair
+from hotstuff_tpu.mempool import Authority as MempoolAuthority
+from hotstuff_tpu.mempool import Committee as MempoolCommittee
+from hotstuff_tpu.mempool import Parameters as MempoolParameters
+
+
+class ConfigError(Exception):
+    pass
+
+
+def _parse_addr(s: str) -> tuple[str, int]:
+    host, _, port = s.rpartition(":")
+    return (host, int(port))
+
+
+def _fmt_addr(a: tuple[str, int]) -> str:
+    return f"{a[0]}:{a[1]}"
+
+
+@dataclass
+class Secret:
+    name: PublicKey
+    secret: SecretKey
+
+    @classmethod
+    def new(cls) -> "Secret":
+        pk, sk = generate_keypair()
+        return cls(pk, sk)
+
+    @classmethod
+    def default(cls) -> "Secret":
+        """Fixed-seed key for tests (reference ``config.rs:73-79``)."""
+        rng = random.Random(0)
+        pk, sk = generate_keypair(seed=rng.randbytes(32))
+        return cls(pk, sk)
+
+    @classmethod
+    def read(cls, path: str) -> "Secret":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            return cls(
+                PublicKey.decode_base64(data["name"]),
+                SecretKey.decode_base64(data["secret"]),
+            )
+        except (OSError, KeyError, ValueError) as e:
+            raise ConfigError(f"failed to read config file '{path}': {e}") from e
+
+    def write(self, path: str) -> None:
+        with open(path, "w") as f:
+            json.dump(
+                {"name": self.name.encode_base64(), "secret": self.secret.encode_base64()},
+                f,
+                indent=4,
+                sort_keys=True,
+            )
+            f.write("\n")
+
+
+@dataclass
+class Committee:
+    consensus: ConsensusCommittee
+    mempool: MempoolCommittee
+
+    @classmethod
+    def read(cls, path: str) -> "Committee":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            consensus = ConsensusCommittee(
+                authorities={
+                    PublicKey.decode_base64(a["name"]): ConsensusAuthority(
+                        stake=int(a["stake"]), address=_parse_addr(a["address"])
+                    )
+                    for a in data["consensus"]["authorities"].values()
+                },
+                epoch=int(data["consensus"].get("epoch", 1)),
+            )
+            mempool = MempoolCommittee(
+                authorities={
+                    PublicKey.decode_base64(a["name"]): MempoolAuthority(
+                        stake=int(a["stake"]),
+                        transactions_address=_parse_addr(a["transactions_address"]),
+                        mempool_address=_parse_addr(a["mempool_address"]),
+                    )
+                    for a in data["mempool"]["authorities"].values()
+                },
+                epoch=int(data["mempool"].get("epoch", 1)),
+            )
+            return cls(consensus, mempool)
+        except (OSError, KeyError, ValueError) as e:
+            raise ConfigError(f"failed to read config file '{path}': {e}") from e
+
+    def write(self, path: str) -> None:
+        data = {
+            "consensus": {
+                "authorities": {
+                    pk.encode_base64(): {
+                        "name": pk.encode_base64(),
+                        "stake": a.stake,
+                        "address": _fmt_addr(a.address),
+                    }
+                    for pk, a in self.consensus.authorities.items()
+                },
+                "epoch": self.consensus.epoch,
+            },
+            "mempool": {
+                "authorities": {
+                    pk.encode_base64(): {
+                        "name": pk.encode_base64(),
+                        "stake": a.stake,
+                        "transactions_address": _fmt_addr(a.transactions_address),
+                        "mempool_address": _fmt_addr(a.mempool_address),
+                    }
+                    for pk, a in self.mempool.authorities.items()
+                },
+                "epoch": self.mempool.epoch,
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=4, sort_keys=True)
+            f.write("\n")
+
+
+@dataclass
+class Parameters:
+    consensus: ConsensusParameters
+    mempool: MempoolParameters
+
+    @classmethod
+    def default(cls) -> "Parameters":
+        return cls(ConsensusParameters(), MempoolParameters())
+
+    @classmethod
+    def read(cls, path: str) -> "Parameters":
+        try:
+            with open(path) as f:
+                data = json.load(f)
+            c, m = data.get("consensus", {}), data.get("mempool", {})
+            return cls(
+                ConsensusParameters(
+                    timeout_delay=int(c.get("timeout_delay", 5_000)),
+                    sync_retry_delay=int(c.get("sync_retry_delay", 10_000)),
+                ),
+                MempoolParameters(
+                    gc_depth=int(m.get("gc_depth", 50)),
+                    sync_retry_delay=int(m.get("sync_retry_delay", 5_000)),
+                    sync_retry_nodes=int(m.get("sync_retry_nodes", 3)),
+                    batch_size=int(m.get("batch_size", 500_000)),
+                    max_batch_delay=int(m.get("max_batch_delay", 100)),
+                ),
+            )
+        except (OSError, ValueError) as e:
+            raise ConfigError(f"failed to read config file '{path}': {e}") from e
+
+    def write(self, path: str) -> None:
+        data = {
+            "consensus": {
+                "timeout_delay": self.consensus.timeout_delay,
+                "sync_retry_delay": self.consensus.sync_retry_delay,
+            },
+            "mempool": {
+                "gc_depth": self.mempool.gc_depth,
+                "sync_retry_delay": self.mempool.sync_retry_delay,
+                "sync_retry_nodes": self.mempool.sync_retry_nodes,
+                "batch_size": self.mempool.batch_size,
+                "max_batch_delay": self.mempool.max_batch_delay,
+            },
+        }
+        with open(path, "w") as f:
+            json.dump(data, f, indent=4, sort_keys=True)
+            f.write("\n")

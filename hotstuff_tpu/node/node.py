@@ -1,0 +1,90 @@
+"""Node composition root (reference ``node/src/node.rs:18-81``): read
+committee + secret, open the store, start the signature service, spawn
+Mempool and Consensus wired by three channel pairs, and consume the commit
+stream (``analyze_block`` is the application/execution attach point)."""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+
+from hotstuff_tpu.consensus import Consensus
+from hotstuff_tpu.crypto import SignatureService
+from hotstuff_tpu.mempool import Mempool
+from hotstuff_tpu.store import Store
+
+from .config import Committee, Parameters, Secret
+
+log = logging.getLogger("node")
+
+CHANNEL_CAPACITY = 1_000
+
+
+class Node:
+    def __init__(self) -> None:
+        self.commit: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        self.mempool: Mempool | None = None
+        self.consensus: Consensus | None = None
+        self.store: Store | None = None
+
+    @classmethod
+    async def new(
+        cls,
+        committee_file: str,
+        key_file: str,
+        store_path: str,
+        parameters_file: str | None = None,
+        benchmark: bool = False,
+    ) -> "Node":
+        self = cls()
+        secret = Secret.read(key_file)
+        committee = Committee.read(committee_file)
+        parameters = (
+            Parameters.read(parameters_file) if parameters_file else Parameters.default()
+        )
+        self.store = Store(store_path)
+
+        signature_service = SignatureService(secret.secret)
+
+        tx_consensus_to_mempool: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_mempool_to_consensus: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+
+        self.mempool = Mempool(
+            secret.name,
+            committee.mempool,
+            parameters.mempool,
+            self.store,
+            tx_consensus_to_mempool,
+            tx_mempool_to_consensus,
+            benchmark=benchmark,
+        )
+        await self.mempool.spawn()
+
+        self.consensus = await Consensus.spawn(
+            secret.name,
+            committee.consensus,
+            parameters.consensus,
+            signature_service,
+            self.store,
+            tx_mempool_to_consensus,
+            tx_consensus_to_mempool,
+            self.commit,
+            benchmark=benchmark,
+        )
+
+        log.info("Node %s successfully booted", secret.name)
+        return self
+
+    async def analyze_block(self) -> None:
+        """Sink committed blocks — the execution-engine attach point
+        (reference ``node/src/node.rs:76-80``)."""
+        while True:
+            await self.commit.get()
+
+    async def shutdown(self) -> None:
+        if self.consensus is not None:
+            await self.consensus.shutdown()
+        if self.mempool is not None:
+            await self.mempool.shutdown()
+        if self.store is not None:
+            self.store.close()

@@ -30,6 +30,69 @@ def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:
     return [generate_keypair(seed=rng.randbytes(32))[0:2] for _ in range(n)]
 
 
+def mempool_committee(base_port: int, n: int = 4):
+    """4-node localhost mempool committee with a per-test base port
+    (reference ``mempool/src/tests/common.rs``)."""
+    from hotstuff_tpu.mempool import Authority, Committee
+
+    return Committee(
+        authorities={
+            pk: Authority(
+                stake=1,
+                transactions_address=("127.0.0.1", base_port + i),
+                mempool_address=("127.0.0.1", base_port + 100 + i),
+            )
+            for i, (pk, _) in enumerate(keys(n))
+        }
+    )
+
+
+def consensus_committee(base_port: int, n: int = 4):
+    """4-node localhost consensus committee (reference
+    ``consensus/src/tests/common.rs:23-46``)."""
+    from hotstuff_tpu.consensus import Authority, Committee
+
+    return Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", base_port + i))
+            for i, (pk, _) in enumerate(keys(n))
+        }
+    )
+
+
+def chain(n_blocks: int, key_list=None):
+    """A valid block chain rooted at genesis: block r is authored by the
+    round-r leader and carries a full QC over its parent (reference
+    ``consensus/src/tests/common.rs:147-179``)."""
+    from hotstuff_tpu.consensus.messages import QC, Block
+    from hotstuff_tpu.crypto import Signature
+
+    key_list = key_list or keys()
+    by_pk = dict(key_list)
+    sorted_pks = sorted(by_pk.keys())
+
+    def leader(r):
+        return sorted_pks[r % len(sorted_pks)]
+
+    blocks = []
+    qc = QC.genesis()
+    for r in range(1, n_blocks + 1):
+        author = leader(r)
+        block = Block.new_from_key(qc, None, author, r, [], by_pk[author])
+        blocks.append(block)
+        qc = QC(hash=block.digest(), round=r, votes=[])
+        qc.votes = [(pk, Signature.new(qc.digest(), sk)) for pk, sk in key_list]
+    return blocks
+
+
+def qc_vote_digest(block_digest, round_: int):
+    """The digest each QC vote signs (== QC.digest() of the certified
+    block)."""
+    from hotstuff_tpu.consensus.messages import QC
+
+    return QC(hash=block_digest, round=round_, votes=[]).digest()
+
+
 async def listener(port: int, expected: bytes | None = None, reply: bytes = b"Ack"):
     """One-shot TCP server: accept, read one length-delimited frame, reply
     ``Ack``, optionally assert the payload. Returns the received frame.

@@ -1,0 +1,226 @@
+"""Core behavior tests with listener doubles — modeled on reference
+``consensus/src/tests/core_tests.rs:70-192``: proposal -> vote to next
+leader, 2f+1 votes -> proposer Make, chain -> commit, timeout broadcast,
+plus the crash-recovery persistence fix (state restored after restart)."""
+
+import asyncio
+
+from hotstuff_tpu.consensus.config import Parameters
+from hotstuff_tpu.consensus.core import Core
+from hotstuff_tpu.consensus.leader import LeaderElector
+from hotstuff_tpu.consensus.mempool_driver import MempoolDriver
+from hotstuff_tpu.consensus.messages import (
+    Vote,
+    decode_message,
+    encode_propose,
+)
+from hotstuff_tpu.consensus.proposer import Make
+from hotstuff_tpu.consensus.synchronizer import Synchronizer
+from hotstuff_tpu.crypto import SignatureService
+from hotstuff_tpu.store import Store
+
+from .common import async_test, chain, consensus_committee, keys, listener
+
+BASE = 13100
+
+
+def spawn_core(name_idx: int, committee, store=None, timeout_delay=10_000):
+    """Wire a Core with real channels; returns the handles a test needs."""
+    pk, sk = keys()[name_idx]
+    store = store or Store()
+    tx_message, tx_loopback = asyncio.Queue(), asyncio.Queue()
+    tx_proposer, tx_commit = asyncio.Queue(), asyncio.Queue()
+    tx_mempool = asyncio.Queue()
+    synchronizer = Synchronizer(pk, committee, store, tx_loopback, 10_000)
+    driver = MempoolDriver(store, tx_mempool, tx_loopback)
+    task = Core.spawn(
+        pk,
+        committee,
+        SignatureService(sk),
+        store,
+        LeaderElector(committee),
+        driver,
+        synchronizer,
+        timeout_delay,
+        tx_message,
+        tx_loopback,
+        tx_proposer,
+        tx_commit,
+    )
+    return {
+        "pk": pk,
+        "store": store,
+        "rx": tx_message,
+        "proposer": tx_proposer,
+        "commit": tx_commit,
+        "mempool": tx_mempool,
+        "task": task,
+        "sync": synchronizer,
+    }
+
+
+def leader_index(committee, round_):
+    lead = LeaderElector(committee).get_leader(round_)
+    return [i for i, (pk, _) in enumerate(keys()) if pk == lead][0]
+
+
+@async_test
+async def test_proposal_sends_vote_to_next_leader():
+    committee = consensus_committee(BASE)
+    blocks = chain(1)
+    # Pick a node that is neither leader(1) (author) nor leader(2) (vote target).
+    l1, l2 = leader_index(committee, 1), leader_index(committee, 2)
+    me = next(i for i in range(4) if i not in (l1, l2))
+    node = spawn_core(me, committee)
+    next_leader_addr = committee.address(keys()[l2][0])
+    lst = asyncio.create_task(listener(next_leader_addr[1]))
+    await asyncio.sleep(0.05)
+    await node["rx"].put(("propose", blocks[0]))
+    frame = await asyncio.wait_for(lst, 5)
+    kind, vote = decode_message(frame)
+    assert kind == "vote"
+    assert vote.hash == blocks[0].digest() and vote.round == 1
+    assert vote.author == node["pk"]
+    vote.verify(committee)
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+@async_test
+async def test_quorum_of_votes_triggers_proposal():
+    committee = consensus_committee(BASE + 10)
+    blocks = chain(1)
+    me = leader_index(committee, 2)  # we lead round 2 -> QC at 1 makes us propose
+    node = spawn_core(me, committee)
+    votes = [
+        Vote.new_from_key(blocks[0].digest(), 1, pk, sk) for pk, sk in keys()[:3]
+    ]
+    for v in votes:
+        await node["rx"].put(("vote", v))
+    while True:
+        msg = await asyncio.wait_for(node["proposer"].get(), 5)
+        if isinstance(msg, Make) and msg.round == 2:
+            assert msg.qc.hash == blocks[0].digest()
+            break
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+@async_test
+async def test_chain_commits_first_block():
+    committee = consensus_committee(BASE + 20)
+    blocks = chain(3)
+    # Use a node that never needs to lead; sink its votes via listeners.
+    listeners = [
+        asyncio.create_task(listener(a.address[1], reply=b"Ack"))
+        for pk, a in committee.authorities.items()
+    ]
+    me = 0
+    node = spawn_core(me, committee)
+    await asyncio.sleep(0.05)
+    for b in blocks:
+        await node["rx"].put(("propose", b))
+    committed = await asyncio.wait_for(node["commit"].get(), 5)
+    assert committed.digest() == blocks[0].digest()
+    node["task"].cancel()
+    node["sync"].shutdown()
+    for t in listeners:
+        t.cancel()
+
+
+@async_test
+async def test_local_timeout_broadcasts_timeout_message():
+    committee = consensus_committee(BASE + 30)
+    me = 0
+    others = [
+        a.address[1]
+        for pk, a in committee.authorities.items()
+        if pk != keys()[me][0]
+    ]
+    listeners = [asyncio.create_task(listener(p)) for p in others]
+    await asyncio.sleep(0.05)
+    node = spawn_core(me, committee, timeout_delay=100)
+    frames = await asyncio.wait_for(asyncio.gather(*listeners), 5)
+    for f in frames:
+        kind, timeout = decode_message(f)
+        assert kind == "timeout"
+        assert timeout.round == 1 and timeout.author == node["pk"]
+        timeout.verify(committee)
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+@async_test
+async def test_voting_state_survives_restart():
+    """The reference's issue-#15 fix: after voting in round 1 and
+    restarting, the node must refuse to vote for a conflicting round-1
+    block."""
+    committee = consensus_committee(BASE + 40)
+    blocks = chain(1)
+    l1, l2 = leader_index(committee, 1), leader_index(committee, 2)
+    me = next(i for i in range(4) if i not in (l1, l2))
+    store = Store()
+
+    node = spawn_core(me, committee, store=store)
+    addr = committee.address(keys()[l2][0])
+    lst = asyncio.create_task(listener(addr[1]))
+    await asyncio.sleep(0.05)
+    await node["rx"].put(("propose", blocks[0]))
+    await asyncio.wait_for(lst, 5)  # voted once
+    node["task"].cancel()
+    node["sync"].shutdown()
+    await asyncio.sleep(0)
+
+    # Restart on the same store; feed a CONFLICTING round-1 proposal.
+    node2 = spawn_core(me, committee, store=store)
+    assert node2 is not None
+    await asyncio.sleep(0.05)
+    assert node2["task"].done() is False
+    # State restored: last_voted_round >= 1, so no vote for round 1 again.
+    conflicting = chain(1, key_list=keys())  # same round, same author
+    conflicting[0].payload = []  # identical chain; simulate re-vote attempt
+    vote_listener = asyncio.create_task(listener(addr[1]))
+    await asyncio.sleep(0.05)
+    await node2["rx"].put(("propose", conflicting[0]))
+    done, pending = await asyncio.wait({vote_listener}, timeout=1.0)
+    assert not done, "restarted node voted twice for round 1"
+    vote_listener.cancel()
+    node2["task"].cancel()
+    node2["sync"].shutdown()
+
+
+@async_test
+async def test_sync_request_on_missing_parent():
+    """Processing a block with an unknown parent fires a SyncRequest to the
+    author and resumes once the parent arrives (reference
+    ``synchronizer_tests.rs:60-110``)."""
+    committee = consensus_committee(BASE + 50)
+    blocks = chain(3)
+    me = 0
+    node = spawn_core(me, committee)
+    author_addr = committee.address(blocks[2].author)
+    sync_listener = asyncio.create_task(listener(author_addr[1]))
+    # Also sink votes everywhere.
+    other_listeners = [
+        asyncio.create_task(listener(a.address[1]))
+        for pk, a in committee.authorities.items()
+        if a.address != author_addr
+    ]
+    await asyncio.sleep(0.05)
+    # Feed block 3 only: parents (blocks 1, 2) unknown.
+    await node["rx"].put(("propose", blocks[2]))
+    frame = await asyncio.wait_for(sync_listener, 5)
+    kind, (digest, origin) = decode_message(frame)
+    assert kind == "sync_request"
+    assert digest == blocks[1].digest()  # asks for the direct parent
+    assert origin == node["pk"]
+    # Deliver the missing ancestors via the store (as the helper would).
+    await node["store"].write(blocks[0].digest().data, blocks[0].serialize())
+    await node["store"].write(blocks[1].digest().data, blocks[1].serialize())
+    # The parked block resumes and commits block 1.
+    committed = await asyncio.wait_for(node["commit"].get(), 5)
+    assert committed.digest() == blocks[0].digest()
+    node["task"].cancel()
+    node["sync"].shutdown()
+    for t in other_listeners:
+        t.cancel()

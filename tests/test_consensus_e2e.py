@@ -1,0 +1,68 @@
+"""Distributed-without-a-cluster: 4 complete consensus engines in one
+process on real localhost TCP ports (mempool channels sunk), asserting all
+four commit the same first block (reference
+``consensus/src/tests/consensus_tests.rs:10-68``)."""
+
+import asyncio
+
+from hotstuff_tpu.consensus import Consensus, Parameters
+from hotstuff_tpu.crypto import SignatureService
+from hotstuff_tpu.store import Store
+
+from .common import async_test, consensus_committee, keys
+
+BASE = 13300
+
+
+@async_test
+async def test_end_to_end_four_nodes():
+    committee = consensus_committee(BASE)
+    params = Parameters(timeout_delay=2_000)
+
+    engines = []
+    commits = []
+    sinks = []
+    for pk, sk in keys():
+        rx_mempool: asyncio.Queue = asyncio.Queue()  # no payload digests
+        tx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_commit: asyncio.Queue = asyncio.Queue()
+
+        # Sink the consensus->mempool channel.
+        async def drain(q=tx_mempool):
+            while True:
+                await q.get()
+
+        sinks.append(asyncio.create_task(drain()))
+        engine = await Consensus.spawn(
+            pk,
+            committee,
+            params,
+            SignatureService(sk),
+            Store(),
+            rx_mempool,
+            tx_mempool,
+            tx_commit,
+        )
+        engines.append(engine)
+        commits.append(tx_commit)
+
+    # All four nodes must commit the same first block.
+    first = await asyncio.wait_for(
+        asyncio.gather(*[q.get() for q in commits]), 30
+    )
+    digests = {b.digest() for b in first}
+    assert len(digests) == 1, "nodes committed different first blocks"
+    rounds = {b.round for b in first}
+    assert rounds == {1}
+
+    # And keep agreeing for a few more blocks.
+    for _ in range(3):
+        nxt = await asyncio.wait_for(
+            asyncio.gather(*[q.get() for q in commits]), 30
+        )
+        assert len({b.digest() for b in nxt}) == 1
+
+    for e in engines:
+        await e.shutdown()
+    for s in sinks:
+        s.cancel()

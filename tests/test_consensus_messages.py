@@ -1,0 +1,205 @@
+"""Message/QC/TC verification tests incl. adversarial cases — modeled on
+reference ``consensus/src/tests/messages_tests.rs:8-55`` and
+``aggregator_tests.rs:5-56``."""
+
+import pytest
+
+from hotstuff_tpu.consensus import errors
+from hotstuff_tpu.consensus.aggregator import Aggregator
+from hotstuff_tpu.consensus.messages import (
+    QC,
+    TC,
+    Block,
+    Timeout,
+    Vote,
+    decode_message,
+    encode_propose,
+    encode_sync_request,
+    encode_tc,
+    encode_timeout,
+    encode_vote,
+)
+from hotstuff_tpu.crypto import Signature, generate_keypair, sha512_digest
+
+from .common import chain, consensus_committee, keys, qc_vote_digest
+
+BASE = 13000
+
+
+def make_qc(committee=None, n_votes=4):
+    blocks = chain(1)
+    block = blocks[0]
+    votes = [
+        (pk, Signature.new(qc_vote_digest(block.digest(), 1), sk))
+        for pk, sk in keys()[:n_votes]
+    ]
+    return QC(hash=block.digest(), round=1, votes=votes)
+
+
+def test_verify_valid_qc():
+    make_qc().verify(consensus_committee(BASE))  # must not raise
+
+
+def test_qc_authority_reuse():
+    qc = make_qc()
+    qc.votes[1] = qc.votes[0]
+    with pytest.raises(errors.AuthorityReuse):
+        qc.verify(consensus_committee(BASE))
+
+
+def test_qc_unknown_authority():
+    qc = make_qc()
+    stranger_pk, stranger_sk = generate_keypair(seed=b"\x42" * 32)
+    qc.votes[0] = (stranger_pk, qc.votes[0][1])
+    with pytest.raises(errors.UnknownAuthority):
+        qc.verify(consensus_committee(BASE))
+
+
+def test_qc_insufficient_stake():
+    qc = make_qc(n_votes=2)  # 2 < 2f+1 = 3
+    with pytest.raises(errors.QCRequiresQuorum):
+        qc.verify(consensus_committee(BASE))
+
+
+def test_qc_bad_signature():
+    qc = make_qc()
+    pk0, _ = keys()[0]
+    qc.votes[0] = (pk0, Signature(bytes(64)))
+    with pytest.raises(errors.InvalidSignature):
+        qc.verify(consensus_committee(BASE))
+
+
+def test_verify_valid_block():
+    blocks = chain(2)
+    blocks[1].verify(consensus_committee(BASE))  # block 2 embeds a real QC
+
+
+def test_block_wrong_signature():
+    blocks = chain(2)
+    blocks[1].signature = Signature(bytes(64))
+    with pytest.raises(errors.InvalidSignature):
+        blocks[1].verify(consensus_committee(BASE))
+
+
+def test_valid_tc():
+    committee = consensus_committee(BASE)
+    import struct
+
+    votes = []
+    for pk, sk in keys()[:3]:
+        digest = sha512_digest(struct.pack("<Q", 5), struct.pack("<Q", 2))
+        votes.append((pk, Signature.new(digest, sk), 2))
+    tc = TC(round=5, votes=votes)
+    tc.verify(committee)
+    assert tc.high_qc_rounds() == [2, 2, 2]
+
+
+def test_tc_insufficient_stake():
+    import struct
+
+    votes = []
+    for pk, sk in keys()[:2]:
+        digest = sha512_digest(struct.pack("<Q", 5), struct.pack("<Q", 2))
+        votes.append((pk, Signature.new(digest, sk), 2))
+    with pytest.raises(errors.TCRequiresQuorum):
+        TC(round=5, votes=votes).verify(consensus_committee(BASE))
+
+
+def test_timeout_roundtrip_and_verify():
+    committee = consensus_committee(BASE)
+    pk, sk = keys()[0]
+    t = Timeout.new_from_key(QC.genesis(), 3, pk, sk)
+    t.verify(committee)
+    kind, decoded = decode_message(encode_timeout(t))
+    assert kind == "timeout"
+    assert decoded.round == 3 and decoded.author == pk
+    decoded.verify(committee)
+
+
+def test_wire_roundtrips():
+    blocks = chain(3)
+    kind, b = decode_message(encode_propose(blocks[2]))
+    assert kind == "propose" and b.digest() == blocks[2].digest()
+    assert b.qc.votes == blocks[2].qc.votes
+
+    pk, sk = keys()[0]
+    vote = Vote.new_from_key(blocks[0].digest(), 1, pk, sk)
+    kind, v = decode_message(encode_vote(vote))
+    assert kind == "vote" and v.digest() == vote.digest()
+    assert v.signature == vote.signature
+
+    tc = TC(round=7, votes=[(pk, Signature.new(sha512_digest(b"x"), sk), 3)])
+    kind, t = decode_message(encode_tc(tc))
+    assert kind == "tc" and t.round == 7 and t.votes == tc.votes
+
+    d = sha512_digest(b"blk")
+    kind, (digest, origin) = decode_message(encode_sync_request(d, pk))
+    assert kind == "sync_request" and digest == d and origin == pk
+
+
+def test_block_store_roundtrip():
+    blocks = chain(2)
+    data = blocks[1].serialize()
+    restored = Block.deserialize(data)
+    assert restored.digest() == blocks[1].digest()
+    assert restored.qc == blocks[1].qc
+    assert restored.signature == blocks[1].signature
+
+
+def test_genesis_identities():
+    g = Block.genesis()
+    assert g.round == 0 and g.qc == QC.genesis() and g.payload == []
+
+
+# ---------------------------------------------------------------------------
+# Aggregator
+# ---------------------------------------------------------------------------
+
+
+def test_aggregator_makes_qc_at_quorum():
+    committee = consensus_committee(BASE)
+    agg = Aggregator(committee)
+    block = chain(1)[0]
+    votes = [
+        Vote.new_from_key(block.digest(), 1, pk, sk) for pk, sk in keys()
+    ]
+    assert agg.add_vote(votes[0]) is None
+    assert agg.add_vote(votes[1]) is None
+    qc = agg.add_vote(votes[2])
+    assert qc is not None and qc.round == 1 and len(qc.votes) == 3
+    qc.verify(committee)
+    # The fourth vote does NOT produce a second QC.
+    assert agg.add_vote(votes[3]) is None
+
+
+def test_aggregator_rejects_authority_reuse():
+    agg = Aggregator(consensus_committee(BASE))
+    block = chain(1)[0]
+    pk, sk = keys()[0]
+    vote = Vote.new_from_key(block.digest(), 1, pk, sk)
+    agg.add_vote(vote)
+    with pytest.raises(errors.AuthorityReuse):
+        agg.add_vote(vote)
+
+
+def test_aggregator_timeouts_make_tc():
+    committee = consensus_committee(BASE)
+    agg = Aggregator(committee)
+    touts = [
+        Timeout.new_from_key(QC.genesis(), 4, pk, sk) for pk, sk in keys()
+    ]
+    assert agg.add_timeout(touts[0]) is None
+    assert agg.add_timeout(touts[1]) is None
+    tc = agg.add_timeout(touts[2])
+    assert tc is not None and tc.round == 4
+    tc.verify(committee)
+
+
+def test_aggregator_cleanup():
+    agg = Aggregator(consensus_committee(BASE))
+    block = chain(1)[0]
+    pk, sk = keys()[0]
+    agg.add_vote(Vote.new_from_key(block.digest(), 1, pk, sk))
+    assert agg.votes_aggregators
+    agg.cleanup(2)
+    assert not agg.votes_aggregators

@@ -1,0 +1,189 @@
+"""Mempool tests — one per actor plus a whole-mempool test, modeled on the
+reference (``mempool/src/tests/``): batch sealing by size and by timer,
+quorum ACK counting, processor hash+store+forward, sync request emission,
+batch serving, and client txs driven through to the consensus digest
+channel."""
+
+import asyncio
+
+from hotstuff_tpu.crypto import sha512_digest
+from hotstuff_tpu.mempool import Cleanup, Mempool, Parameters, Synchronize
+from hotstuff_tpu.mempool.batch_maker import BatchMaker
+from hotstuff_tpu.mempool.helper import Helper
+from hotstuff_tpu.mempool.messages import decode, encode_batch
+from hotstuff_tpu.mempool.processor import Processor
+from hotstuff_tpu.mempool.quorum_waiter import QuorumWaiter, QuorumWaiterMessage
+from hotstuff_tpu.mempool.synchronizer import Synchronizer
+from hotstuff_tpu.network.receiver import read_frame, write_frame
+from hotstuff_tpu.store import Store
+
+from .common import async_test, keys, listener, mempool_committee
+
+BASE = 12000
+
+
+def tx(sample_id: int | None = None, size: int = 100) -> bytes:
+    """A transaction: sample txs start with 0 + u64 BE id (reference
+    ``node/src/client.rs:107-121``)."""
+    if sample_id is not None:
+        return b"\x00" + sample_id.to_bytes(8, "big") + b"\x01" * (size - 9)
+    return b"\x01" * size
+
+
+@async_test
+async def test_batch_maker_seals_by_size():
+    committee = mempool_committee(BASE)
+    name = keys()[0][0]
+    rx_tx, tx_msg = asyncio.Queue(), asyncio.Queue()
+    peers = committee.broadcast_addresses(name)
+    listeners = [
+        asyncio.create_task(listener(addr[1])) for _, addr in peers
+    ]
+    await asyncio.sleep(0.05)
+    BatchMaker.spawn(200, 10_000, rx_tx, tx_msg, peers)
+    await rx_tx.put(tx(size=150))
+    await rx_tx.put(tx(size=150))  # 300 B >= 200 B -> seal now, not at timer
+    msg: QuorumWaiterMessage = await asyncio.wait_for(tx_msg.get(), 2)
+    kind, txs = decode(msg.batch)
+    assert kind == "batch" and len(txs) == 2
+    assert len(msg.handlers) == 3
+    # All peers got the exact serialized batch.
+    frames = await asyncio.gather(*listeners)
+    assert frames == [msg.batch] * 3
+
+
+@async_test
+async def test_batch_maker_seals_by_timer():
+    committee = mempool_committee(BASE + 10)
+    name = keys()[0][0]
+    rx_tx, tx_msg = asyncio.Queue(), asyncio.Queue()
+    peers = committee.broadcast_addresses(name)
+    listeners = [asyncio.create_task(listener(addr[1])) for _, addr in peers]
+    await asyncio.sleep(0.05)
+    BatchMaker.spawn(1_000_000, 50, rx_tx, tx_msg, peers)  # 50ms delay
+    await rx_tx.put(tx(size=10))
+    msg = await asyncio.wait_for(tx_msg.get(), 2)
+    kind, txs = decode(msg.batch)
+    assert kind == "batch" and len(txs) == 1
+    await asyncio.gather(*listeners)
+
+
+@async_test
+async def test_quorum_waiter_forwards_at_threshold():
+    committee = mempool_committee(BASE + 20)
+    name = keys()[0][0]
+    rx_msg, tx_batch = asyncio.Queue(), asyncio.Queue()
+    QuorumWaiter.spawn(committee, name, rx_msg, tx_batch)
+    loop = asyncio.get_running_loop()
+    handlers = [(pk, loop.create_future()) for pk, _ in keys()[1:]]
+    await rx_msg.put(QuorumWaiterMessage(b"serialized-batch", handlers))
+    await asyncio.sleep(0.05)
+    assert tx_batch.empty()  # own stake 1 < threshold 3
+    handlers[0][1].set_result(b"Ack")
+    await asyncio.sleep(0.05)
+    assert tx_batch.empty()  # 2 < 3
+    handlers[1][1].set_result(b"Ack")
+    batch = await asyncio.wait_for(tx_batch.get(), 2)
+    assert batch == b"serialized-batch"
+
+
+@async_test
+async def test_processor_hashes_stores_forwards():
+    store = Store()
+    rx_batch, tx_digest = asyncio.Queue(), asyncio.Queue()
+    Processor.spawn(store, rx_batch, tx_digest)
+    batch = encode_batch([tx(size=20)])
+    await rx_batch.put(batch)
+    digest = await asyncio.wait_for(tx_digest.get(), 2)
+    assert digest == sha512_digest(batch)
+    assert await store.read(digest.data) == batch
+
+
+@async_test
+async def test_synchronizer_emits_batch_request():
+    committee = mempool_committee(BASE + 30)
+    (name, _), (target, _) = keys()[0], keys()[1]
+    store = Store()
+    rx_msg = asyncio.Queue()
+    Synchronizer.spawn(name, committee, store, 50, 5_000, 3, rx_msg)
+    missing = sha512_digest(b"missing-batch")
+    target_addr = committee.mempool_address(target)
+    task = asyncio.create_task(listener(target_addr[1]))
+    await asyncio.sleep(0.05)
+    await rx_msg.put(Synchronize([missing], target))
+    frame = await asyncio.wait_for(task, 3)
+    kind, (digests, requestor) = decode(frame)
+    assert kind == "batch_request"
+    assert digests == [missing] and requestor == name
+
+
+@async_test
+async def test_synchronizer_cleanup_cancels_old_waiters():
+    committee = mempool_committee(BASE + 40)
+    name, target = keys()[0][0], keys()[1][0]
+    store = Store()
+    rx_msg = asyncio.Queue()
+    sync = Synchronizer(name, committee, store, 10, 5_000, 3, rx_msg)
+    task = asyncio.create_task(sync._run())
+    target_addr = committee.mempool_address(target)
+    lst = asyncio.create_task(listener(target_addr[1]))
+    await asyncio.sleep(0.05)
+    await rx_msg.put(Synchronize([sha512_digest(b"old")], target))
+    await lst
+    assert len(sync.pending) == 1
+    await rx_msg.put(Cleanup(100))  # round 100, gc_depth 10 -> gc everything <= 90
+    await asyncio.sleep(0.1)
+    assert len(sync.pending) == 0
+    task.cancel()
+
+
+@async_test
+async def test_helper_serves_batches():
+    committee = mempool_committee(BASE + 50)
+    name, requestor = keys()[0][0], keys()[1][0]
+    store = Store()
+    batch = encode_batch([tx(size=30)])
+    digest = sha512_digest(batch)
+    await store.write(digest.data, batch)
+    rx_req = asyncio.Queue()
+    Helper.spawn(committee, store, rx_req)
+    req_addr = committee.mempool_address(requestor)
+    task = asyncio.create_task(listener(req_addr[1]))
+    await asyncio.sleep(0.05)
+    await rx_req.put(([digest], requestor))
+    assert await asyncio.wait_for(task, 3) == batch
+
+
+@async_test
+async def test_whole_mempool_client_tx_to_digest():
+    """Drive real client transactions through a full mempool (with 3 fake
+    ACKing peers) to the consensus digest channel (reference
+    ``mempool_tests.rs:6-46``)."""
+    committee = mempool_committee(BASE + 60)
+    (name, _) = keys()[0]
+    peer_listeners = [
+        asyncio.create_task(listener(addr[1]))
+        for _, addr in committee.broadcast_addresses(name)
+    ]
+    await asyncio.sleep(0.05)
+
+    rx_consensus, tx_consensus = asyncio.Queue(), asyncio.Queue()
+    params = Parameters(batch_size=100, max_batch_delay=10_000)
+    mempool = Mempool(name, committee, params, Store(), rx_consensus, tx_consensus)
+    await mempool.spawn()
+
+    # A real client connection to the transactions address.
+    tx_addr = committee.transactions_address(name)
+    reader, writer = await asyncio.open_connection("127.0.0.1", tx_addr[1])
+    payload = tx(sample_id=7, size=120)  # > batch_size -> immediate seal
+    write_frame(writer, payload)
+    await writer.drain()
+
+    digest = await asyncio.wait_for(tx_consensus.get(), 5)
+    batches = await asyncio.gather(*peer_listeners)
+    assert all(b == batches[0] for b in batches)
+    assert digest == sha512_digest(batches[0])
+    kind, txs = decode(batches[0])
+    assert kind == "batch" and txs == [payload]
+    writer.close()
+    await mempool.shutdown()
