@@ -1,0 +1,80 @@
+"""Headline benchmark: Ed25519 quorum-certificate batch verification on TPU.
+
+Measures µs per signature for the device RLC batch verifier (decompress +
+shared-doubling MSM, one device call) at a committee-1000-scale vote set,
+against the CPU per-signature baseline (OpenSSL, the stand-in for
+ed25519-dalek's CPU batch verify — BASELINE.md's baseline-to-beat).
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": "us/sig", "vs_baseline": N}
+"""
+
+from __future__ import annotations
+
+import json
+import random
+import sys
+import time
+
+
+def make_batch(n_sigs: int, seed: int = 2024):
+    from hotstuff_tpu.crypto import ed25519_ref as ref
+
+    rng = random.Random(seed)
+    msgs, pubs, sigs = [], [], []
+    for _ in range(n_sigs):
+        seed_bytes = rng.randbytes(32)
+        pubs.append(ref.secret_to_public(seed_bytes))
+        msgs.append(rng.randbytes(32))
+        sigs.append(ref.sign(seed_bytes, msgs[-1]))
+    return msgs, pubs, sigs
+
+
+def bench_device(msgs, pubs, sigs, iters: int = 5) -> float:
+    """End-to-end per-batch seconds (host prep + device verify)."""
+    from hotstuff_tpu.ops.verify import verify_batch_device
+
+    rng = random.Random(1)
+    assert verify_batch_device(msgs, pubs, sigs, _rng=rng)  # warm-up/compile
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        assert verify_batch_device(msgs, pubs, sigs, _rng=rng)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_cpu(msgs, pubs, sigs, iters: int = 2) -> float:
+    from hotstuff_tpu.crypto import CpuBackend
+
+    backend = CpuBackend()
+    backend.verify_batch(msgs, pubs, sigs)  # warm-up
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        backend.verify_batch(msgs, pubs, sigs)
+    return (time.perf_counter() - t0) / iters
+
+
+def main() -> None:
+    # Committee-1000 regime: a QC carries 2f+1 = 667 votes; batching two
+    # in-flight QCs ~ 1343 signatures -> 2687 MSM lanes -> 4096 padded.
+    n_sigs = int(sys.argv[1]) if len(sys.argv) > 1 else 1343
+
+    msgs, pubs, sigs = make_batch(n_sigs)
+    cpu_s = bench_cpu(msgs, pubs, sigs)
+    dev_s = bench_device(msgs, pubs, sigs)
+
+    us_per_sig = dev_s / n_sigs * 1e6
+    cpu_us_per_sig = cpu_s / n_sigs * 1e6
+    print(
+        json.dumps(
+            {
+                "metric": f"ed25519_qc_batch_verify_{n_sigs}sigs",
+                "value": round(us_per_sig, 3),
+                "unit": "us/sig",
+                "vs_baseline": round(cpu_us_per_sig / us_per_sig, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

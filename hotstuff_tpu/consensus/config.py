@@ -22,6 +22,10 @@ class Parameters:
 
     timeout_delay: int = 5_000  # ms
     sync_retry_delay: int = 10_000  # ms
+    # fsync the persisted voting state on every update: survives power
+    # loss, at ~ms extra latency per vote. Off by default (process-crash
+    # safety only), matching typical BFT deployment practice.
+    persist_sync: bool = False
 
     def log(self) -> None:
         # Picked up by the benchmark log parser (reference ``config.rs:25-31``).

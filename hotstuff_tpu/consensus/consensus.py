@@ -111,6 +111,7 @@ class Consensus:
                 tx_proposer,
                 tx_commit,
                 benchmark=benchmark,
+                persist_sync=parameters.persist_sync,
             )
         )
         self.tasks.append(

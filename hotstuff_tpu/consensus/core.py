@@ -13,9 +13,11 @@ for the chain ``b0 <- |qc0; b1| <- |qc1; block|``, commit ``b0`` and all its
 uncommitted ancestors.
 
 Crash-safety improvement over the reference: the voting state
-(``last_voted_round``, ``round``, ``high_qc``) is persisted to the store
-before each vote/timeout signature, fixing the reference's acknowledged
-unsafe-recovery TODO (``core.rs:114``, issue #15).
+(``last_voted_round``, ``round``, ``high_qc``) is persisted (bounded
+atomic-replace record, no log growth) before each vote/timeout signature,
+fixing the reference's acknowledged unsafe-recovery TODO (``core.rs:114``,
+issue #15) for process crashes. Power/kernel-crash durability additionally
+requires ``Parameters.persist_sync`` (fsync per state update — slower).
 """
 
 from __future__ import annotations
@@ -69,6 +71,7 @@ class Core:
         tx_proposer: asyncio.Queue,
         tx_commit: asyncio.Queue,
         benchmark: bool = False,
+        persist_sync: bool = False,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -82,6 +85,7 @@ class Core:
         self.tx_proposer = tx_proposer
         self.tx_commit = tx_commit
         self.benchmark = benchmark
+        self.persist_sync = persist_sync
         self.round: Round = 1
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
@@ -101,10 +105,10 @@ class Core:
         enc = Encoder()
         enc.u64(self.round).u64(self.last_voted_round).u64(self.last_committed_round)
         self.high_qc.encode(enc)
-        await self.store.write(_STATE_KEY, enc.finish())
+        await self.store.write_meta(_STATE_KEY, enc.finish(), sync=self.persist_sync)
 
     async def _restore_state(self) -> None:
-        data = await self.store.read(_STATE_KEY)
+        data = await self.store.read_meta(_STATE_KEY)
         if data is None:
             return
         try:

@@ -102,29 +102,14 @@ class Proposer:
         await self.tx_loopback.put(block)
 
         # Control system: wait for 2f+1 stake to ACK before proposing again.
-        total = self.committee.stake(self.name)
-        threshold = self.committee.quorum_threshold()
-        waiters = {
-            asyncio.ensure_future(self._waiter(h, self.committee.stake(n))): h
-            for n, h in handlers
-        }
-        pending = set(waiters)
-        while total < threshold and pending:
-            done, pending = await asyncio.wait(
-                pending, return_when=asyncio.FIRST_COMPLETED
-            )
-            for t in done:
-                total += t.result()
+        from hotstuff_tpu.utils.quorum import cancel_remaining, wait_for_ack_quorum
+
+        _, remaining = await wait_for_ack_quorum(
+            handlers,
+            self.committee.stake,
+            self.committee.stake(self.name),
+            self.committee.quorum_threshold(),
+        )
         # The reference drops the remaining handlers here, cancelling their
         # retransmission — slow nodes catch up via the synchronizer instead.
-        for t in pending:
-            waiters[t].cancel()
-            t.cancel()
-
-    @staticmethod
-    async def _waiter(handler: asyncio.Future, stake: int) -> int:
-        try:
-            await handler
-            return stake
-        except asyncio.CancelledError:
-            return 0
+        cancel_remaining(remaining)

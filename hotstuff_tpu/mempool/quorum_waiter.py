@@ -48,45 +48,29 @@ class QuorumWaiter:
         return asyncio.create_task(self._run(), name="quorum_waiter")
 
     async def _run(self) -> None:
+        from hotstuff_tpu.utils.quorum import cancel_remaining, wait_for_ack_quorum
+
         while True:
             msg: QuorumWaiterMessage = await self.rx_message.get()
-            threshold = self.committee.quorum_threshold()
-            total = self.stake  # our own batch counts for our stake
-            waiters = {
-                asyncio.ensure_future(self._waiter(h, self.committee.stake(name))): h
-                for name, h in msg.handlers
-            }
-            pending = set(waiters)
-            while total < threshold and pending:
-                done, pending = await asyncio.wait(
-                    pending, return_when=asyncio.FIRST_COMPLETED
-                )
-                for t in done:
-                    total += t.result()
-            if total >= threshold:
+            reached, remaining = await wait_for_ack_quorum(
+                msg.handlers,
+                self.committee.stake,
+                self.stake,  # our own batch counts for our stake
+                self.committee.quorum_threshold(),
+            )
+            if reached:
                 await self.tx_batch.put(msg.batch)
             else:
                 log.warning("batch dissemination failed to reach quorum")
             # Let the f slowest nodes keep receiving for a bounded grace
             # period instead of cancelling their retransmissions immediately
             # (reference ``quorum_waiter.rs:104-122``).
-            if pending and len(self._background) < DISSEMINATION_QUEUE_MAX:
-                remaining = {t: waiters[t] for t in pending}
+            if remaining and len(self._background) < DISSEMINATION_QUEUE_MAX:
                 task = asyncio.create_task(self._linger(remaining))
                 self._background.add(task)
                 task.add_done_callback(self._background.discard)
-            elif pending:
-                for t in pending:
-                    waiters[t].cancel()
-                    t.cancel()
-
-    @staticmethod
-    async def _waiter(handler: asyncio.Future, stake: int) -> int:
-        try:
-            await handler
-            return stake
-        except asyncio.CancelledError:
-            return 0
+            elif remaining:
+                cancel_remaining(remaining)
 
     @staticmethod
     async def _linger(remaining: dict[asyncio.Task, asyncio.Future]) -> None:

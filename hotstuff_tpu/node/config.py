@@ -165,6 +165,7 @@ class Parameters:
                 ConsensusParameters(
                     timeout_delay=int(c.get("timeout_delay", 5_000)),
                     sync_retry_delay=int(c.get("sync_retry_delay", 10_000)),
+                    persist_sync=bool(c.get("persist_sync", False)),
                 ),
                 MempoolParameters(
                     gc_depth=int(m.get("gc_depth", 50)),

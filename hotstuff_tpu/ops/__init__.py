@@ -1,0 +1,19 @@
+"""Device kernels (JAX) for the crypto hot path.
+
+This is the TPU-native replacement for the reference's CPU crypto
+(ed25519-dalek batch verification, ``crypto/src/lib.rs:206-219``): GF(2^255-19)
+limb arithmetic on the VPU, Edwards25519 point operations in extended
+coordinates, batched point decompression, and a shared-doubling windowed
+multi-scalar multiplication evaluating the random-linear-combination batch
+verification equation in one device call.
+
+Design notes (TPU-first):
+- Field elements are 20 limbs of 13 bits in ``int32``: schoolbook products
+  are <= 2^26 and 20-term column sums < 2^31, so the whole multiplier runs
+  in native int32 on the 8x128 VPU with no 64-bit emulation.
+- All control flow is static: fixed 64 radix-16 windows via ``lax.scan``,
+  identity-padded power-of-two batches, masked selects instead of branches.
+- The batch dimension is the parallel axis — one verification batch maps to
+  [lanes, 20] arrays; multi-chip sharding splits lanes across a Mesh and
+  combines per-device partial MSM accumulators (``hotstuff_tpu.parallel``).
+"""

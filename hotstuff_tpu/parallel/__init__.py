@@ -1,0 +1,3 @@
+from .mesh import make_mesh, msm_sharded, verify_batch_device_sharded
+
+__all__ = ["make_mesh", "msm_sharded", "verify_batch_device_sharded"]

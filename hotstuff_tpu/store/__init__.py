@@ -35,6 +35,11 @@ class LogEngine:
     Record format: ``u32 klen, u32 vlen, key, value`` (little-endian).
     Buffered appends, flushed per write (no fsync — matches the reference's
     RocksDB usage, which never requests synchronous writes).
+
+    Small frequently-overwritten records (consensus voting state) go through
+    ``put_meta`` instead: a separate fixed-size file updated by atomic
+    replace, so the append log never accumulates superseded versions, with
+    optional fsync for power-crash durability.
     """
 
     def __init__(self, path: str) -> None:
@@ -44,6 +49,28 @@ class LogEngine:
         self._log_path = os.path.join(path, "store.log")
         self._replay()
         self._log = open(self._log_path, "ab")
+
+    def _meta_path(self, key: bytes) -> str:
+        import hashlib
+
+        return os.path.join(self._path, "meta_" + hashlib.sha256(key).hexdigest()[:16])
+
+    def put_meta(self, key: bytes, value: bytes, sync: bool = False) -> None:
+        path = self._meta_path(key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get_meta(self, key: bytes) -> bytes | None:
+        try:
+            with open(self._meta_path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
 
     def _replay(self) -> None:
         if not os.path.exists(self._log_path):
@@ -78,12 +105,19 @@ class MemEngine:
 
     def __init__(self) -> None:
         self._index: dict[bytes, bytes] = {}
+        self._meta: dict[bytes, bytes] = {}
 
     def put(self, key: bytes, value: bytes) -> None:
         self._index[key] = value
 
     def get(self, key: bytes) -> bytes | None:
         return self._index.get(key)
+
+    def put_meta(self, key: bytes, value: bytes, sync: bool = False) -> None:
+        self._meta[key] = value
+
+    def get_meta(self, key: bytes) -> bytes | None:
+        return self._meta.get(key)
 
     def close(self) -> None:
         pass
@@ -119,6 +153,14 @@ class Store:
 
     async def read(self, key: bytes) -> bytes | None:
         return self._engine.get(key)
+
+    async def write_meta(self, key: bytes, value: bytes, sync: bool = False) -> None:
+        """Small bounded record with overwrite semantics (no log growth);
+        ``sync=True`` fsyncs for power-crash durability."""
+        self._engine.put_meta(key, value, sync=sync)
+
+    async def read_meta(self, key: bytes) -> bytes | None:
+        return self._engine.get_meta(key)
 
     async def notify_read(self, key: bytes) -> bytes:
         """Return the value for ``key``, waiting for a future ``write`` if it

@@ -1,13 +1,25 @@
 """Test configuration.
 
-Force JAX onto a virtual 8-device CPU platform BEFORE jax is imported
-anywhere, so multi-chip sharding paths (mesh MSM, dryrun_multichip) are
-exercised without TPU hardware. Bench runs use the real chip instead.
+Force JAX onto a virtual 8-device CPU platform so multi-chip sharding paths
+(mesh MSM, dryrun_multichip) are exercised without TPU hardware, and so
+tests are deterministic. Bench runs use the real chip instead.
+
+Note: pytest plugins may import jax BEFORE this conftest runs (and the
+outer environment pins JAX_PLATFORMS to the experimental axon TPU
+platform), so setting os.environ alone is not enough — we also update
+jax.config if jax is already imported. Backends are not initialized at
+collection time, so this still takes effect.
 """
 
 import os
+import sys
 
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+
+if "jax" in sys.modules:
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")

@@ -1,0 +1,120 @@
+"""GF(2^255-19) device-arithmetic property tests against Python ints
+(bit-exactness is the contract: the pure-Python oracle and the device path
+must agree on every value)."""
+
+import random
+
+import numpy as np
+import pytest
+
+jnp = pytest.importorskip("jax.numpy")
+
+from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402
+from hotstuff_tpu.ops import field as fe  # noqa: E402
+
+rng = random.Random(1234)
+
+
+def rand_ints(n):
+    return [rng.randrange(fe.P) for _ in range(n)]
+
+
+def to_limbs(values):
+    data = np.stack(
+        [np.frombuffer(v.to_bytes(32, "little"), dtype=np.uint8) for v in values]
+    )
+    return jnp.asarray(fe.fe_from_bytes(data))
+
+
+def from_limbs(limbs):
+    arr = np.asarray(fe.canonical(limbs))
+    return [
+        sum(int(arr[i, k]) << (fe.RADIX * k) for k in range(fe.NLIMB))
+        for i in range(arr.shape[0])
+    ]
+
+
+def test_roundtrip_bytes():
+    vals = rand_ints(8) + [0, 1, fe.P - 1]
+    limbs = to_limbs(vals)
+    assert from_limbs(limbs) == [v % fe.P for v in vals]
+    back = fe.fe_to_bytes(np.asarray(fe.canonical(limbs)))
+    for v, row in zip(vals, back):
+        assert int.from_bytes(bytes(row), "little") == v % fe.P
+
+
+def test_add_sub_neg():
+    a_vals, b_vals = rand_ints(16), rand_ints(16)
+    a, b = to_limbs(a_vals), to_limbs(b_vals)
+    assert from_limbs(fe.add(a, b)) == [(x + y) % fe.P for x, y in zip(a_vals, b_vals)]
+    assert from_limbs(fe.sub(a, b)) == [(x - y) % fe.P for x, y in zip(a_vals, b_vals)]
+    assert from_limbs(fe.neg(a)) == [(-x) % fe.P for x in a_vals]
+
+
+def test_mul_square():
+    a_vals, b_vals = rand_ints(16), rand_ints(16)
+    a, b = to_limbs(a_vals), to_limbs(b_vals)
+    assert from_limbs(fe.mul(a, b)) == [(x * y) % fe.P for x, y in zip(a_vals, b_vals)]
+    assert from_limbs(fe.square(a)) == [(x * x) % fe.P for x in a_vals]
+
+
+def test_mul_chain_stays_exact():
+    """Long chains of loose-limb operations (the MSM regime) must not drift
+    or overflow."""
+    a_vals = rand_ints(4)
+    a = to_limbs(a_vals)
+    acc, acc_int = a, list(a_vals)
+    for i in range(30):
+        acc = fe.mul(acc, a)
+        acc = fe.add(acc, acc)
+        acc_int = [(x * y * 2) % fe.P for x, y in zip(acc_int, a_vals)]
+    assert from_limbs(acc) == acc_int
+
+
+def test_inv_pow():
+    a_vals = rand_ints(4)
+    a = to_limbs(a_vals)
+    assert from_limbs(fe.inv(a)) == [pow(x, fe.P - 2, fe.P) for x in a_vals]
+    assert from_limbs(fe.pow_const(a, 7)) == [pow(x, 7, fe.P) for x in a_vals]
+
+
+def test_canonical_edge_cases():
+    # p, p+1, 2p-1 encoded loosely must canonicalize mod p.
+    vals = [fe.P, fe.P + 1, 2 * fe.P - 1, 2**255 - 1]
+    loose = jnp.stack(
+        [jnp.asarray(fe._int_to_limbs(v % (1 << 260)), dtype=jnp.int32) for v in vals]
+    )
+    # _int_to_limbs masks to 20 limbs; these fit in 256 bits so it's exact.
+    assert from_limbs(loose) == [v % fe.P for v in vals]
+
+
+def test_eq_is_zero():
+    a_vals = rand_ints(4)
+    a = to_limbs(a_vals)
+    b = fe.add(a, fe.fe_from_int(0, (4,)))
+    assert bool(jnp.all(fe.eq(a, b)))
+    z = fe.sub(a, a)
+    assert bool(jnp.all(fe.is_zero(z)))
+    assert not bool(jnp.any(fe.is_zero(a)))  # random values aren't 0
+
+
+def test_sqrt_ratio():
+    xs = rand_ints(8)
+    us = [(x * x) % fe.P for x in xs]  # perfect squares (v=1)
+    ok, r = fe.sqrt_ratio(to_limbs(us), fe.fe_from_int(1, (8,)))
+    assert bool(jnp.all(ok))
+    r_vals = from_limbs(r)
+    for x, got in zip(xs, r_vals):
+        assert got == x % fe.P or got == (fe.P - x) % fe.P
+
+    # Non-squares: u = non-residue * square.
+    non_residue = 2  # 2 is a non-square mod p (p ≡ 5 mod 8)
+    bad = [(non_residue * x * x) % fe.P for x in xs]
+    ok2, _ = fe.sqrt_ratio(to_limbs(bad), fe.fe_from_int(1, (8,)))
+    assert not bool(jnp.any(ok2))
+
+
+def test_parity():
+    vals = [2, 3, fe.P - 1, fe.P - 2]
+    limbs = to_limbs(vals)
+    assert list(np.asarray(fe.parity(limbs))) == [v % 2 for v in vals]

@@ -1,0 +1,101 @@
+"""TPU crypto backend tests: acceptance-set equality with the CPU backend
+(cofactored semantics) and wiring through Signature.verify_batch/QC.verify."""
+
+import random
+
+import pytest
+
+pytest.importorskip("jax")
+
+from hotstuff_tpu.crypto import (  # noqa: E402
+    CryptoError,
+    Digest,
+    Signature,
+    set_backend,
+    sha512_digest,
+)
+from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402
+from hotstuff_tpu.ops.verify import verify_batch_device  # noqa: E402
+
+from .common import chain, consensus_committee, keys
+
+
+@pytest.fixture(autouse=True)
+def reset_backend():
+    yield
+    set_backend("cpu")
+
+
+def make_batch(n=3, seed=5):
+    rng = random.Random(seed)
+    msgs, pubs, sigs = [], [], []
+    for _ in range(n):
+        seed_bytes = rng.randbytes(32)
+        pub = ref.secret_to_public(seed_bytes)
+        msg = rng.randbytes(32)
+        msgs.append(msg)
+        pubs.append(pub)
+        sigs.append(ref.sign(seed_bytes, msg))
+    return msgs, pubs, sigs
+
+
+def test_device_accepts_valid_batch():
+    msgs, pubs, sigs = make_batch(4)
+    assert verify_batch_device(msgs, pubs, sigs, _rng=random.Random(1))
+
+
+def test_device_rejects_tampered_message():
+    msgs, pubs, sigs = make_batch(4)
+    msgs[2] = b"\x00" * 32
+    assert not verify_batch_device(msgs, pubs, sigs, _rng=random.Random(1))
+
+
+def test_device_rejects_tampered_signature():
+    msgs, pubs, sigs = make_batch(3)
+    bad = bytearray(sigs[1])
+    bad[3] ^= 1
+    sigs[1] = bytes(bad)
+    assert not verify_batch_device(msgs, pubs, sigs, _rng=random.Random(1))
+
+
+def test_device_rejects_noncanonical_s():
+    msgs, pubs, sigs = make_batch(1)
+    s = int.from_bytes(sigs[0][32:], "little") + ref.L
+    sigs[0] = sigs[0][:32] + s.to_bytes(32, "little")
+    assert not verify_batch_device(msgs, pubs, sigs, _rng=random.Random(1))
+
+
+def test_device_accepts_torsioned_signature_like_cpu():
+    """Cofactored acceptance parity: a signature whose R carries an
+    8-torsion component must be ACCEPTED, matching CpuBackend (see
+    test_crypto.test_cofactored_batch_semantics_unified)."""
+    rng = random.Random(9)
+    seed = rng.randbytes(32)
+    a, _ = ref.secret_expand(seed)
+    pub = ref.point_compress(ref.point_mul(a, ref.G))
+    msg = rng.randbytes(32)
+    t8 = ref.torsion_generator()
+    r = rng.getrandbits(250) % ref.L
+    r_enc = ref.point_compress(ref.point_add(ref.point_mul(r, ref.G), t8))
+    h = ref.compute_challenge(r_enc, pub, msg)
+    s = (r + h * a) % ref.L
+    sig = r_enc + int.to_bytes(s, 32, "little")
+    assert ref.verify(pub, msg, sig, strict=False)
+    assert verify_batch_device([msg], [pub], [sig], _rng=random.Random(1))
+
+
+def test_tpu_backend_through_signature_api():
+    set_backend("tpu")
+    d = sha512_digest(b"quorum certificate")
+    votes = [(pk, Signature.new(d, sk)) for pk, sk in keys(4)]
+    Signature.verify_batch(d, votes)  # must not raise
+    votes[1] = (votes[1][0], Signature(bytes(64)))
+    with pytest.raises(CryptoError):
+        Signature.verify_batch(d, votes)
+
+
+def test_tpu_backend_qc_verify():
+    set_backend("tpu")
+    committee = consensus_committee(14000)
+    blocks = chain(2)
+    blocks[1].verify(committee)  # embedded QC batch-verifies on device
