@@ -106,17 +106,18 @@ def is_identity(p: jnp.ndarray) -> jnp.ndarray:
     return fe.is_zero(x) & fe.eq(y, z)
 
 
-def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray):
+def decompress(y_limbs: jnp.ndarray, sign: jnp.ndarray, root_fn=None):
     """Batch point decompression: x^2 = (y^2-1)/(d y^2+1).
 
     ``y_limbs``: int32[..., 20] (the 255-bit y; the caller host-side rejects
     non-canonical y >= p and strips the sign bit); ``sign``: int32[...] in
-    {0,1}. Returns (ok[...], point[..., 4, 20]).
+    {0,1}. Returns (ok[...], point[..., 4, 20]). ``root_fn`` routes the
+    heavy exponentiation to the Pallas kernel on TPU.
     """
     yy = fe.square(y_limbs)
     u = fe.sub(yy, fe.fe_from_int(1, yy.shape[:-1]))
     v = fe.add(fe.mul(yy, jnp.asarray(fe.D_LIMBS)), fe.fe_from_int(1, yy.shape[:-1]))
-    ok, x = fe.sqrt_ratio(u, v)
+    ok, x = fe.sqrt_ratio(u, v, root_fn=root_fn)
     x = fe.canonical(x)
     flip = (x[..., 0] & 1) != sign
     x = fe.select(flip, fe.neg(x), x)

@@ -36,14 +36,72 @@ _IDENTITY_ENC = (1).to_bytes(32, "little")  # y=1, sign 0
 _HALF_MASK = (1 << 255) - 1
 
 
+def _unpack_device(packed):
+    """Device-side unpacking of the [m, 65] uint8 batch layout:
+    bytes 0..31 point encoding (LE), 32..63 RLC scalar (LE), 64 sign.
+
+    One packed array means ONE host->device transfer per batch — on this
+    platform every transfer costs a full tunnel round trip regardless of
+    size, so the old 3-array layout tripled the floor.
+    """
+    b = packed.astype(jnp.int32)
+    enc = b[:, :32]
+    # y limbs: 13-bit windows over a 3-byte read (13+7 <= 21 bits).
+    limbs = []
+    for k in range(fe.NLIMB):
+        bit = fe.RADIX * k
+        byte, off = bit // 8, bit % 8
+        window = enc[:, byte]
+        window = window + (enc[:, byte + 1] << 8 if byte + 1 < 32 else 0)
+        if byte + 2 < 32:
+            window = window + (enc[:, byte + 2] << 16)
+        limbs.append((window >> off) & fe.MASK)
+    y_limbs = jnp.stack(limbs, axis=-1)
+    # Clear the sign bit's contribution from the top limb (bit 255 =
+    # limb 19 bit 8).
+    y_limbs = y_limbs.at[:, fe.NLIMB - 1].set(y_limbs[:, fe.NLIMB - 1] & 0xFF)
+    signs = b[:, 64]
+    # Radix-16 digits, MSB-first: digit w = nibble 63-w of the scalar.
+    sc = b[:, 32:64]
+    digit_rows = []
+    for w in range(64):
+        nib = 63 - w
+        byte = sc[:, nib // 2]
+        digit_rows.append((byte >> 4) & 0xF if nib % 2 else byte & 0xF)
+    digits = jnp.stack(digit_rows, axis=0)
+    return y_limbs, signs, digits
+
+
+def _kernels():
+    """(root_fn, msm_fn) for the current backend: the Pallas mega-kernels on
+    TPU (the XLA lowering is kernel-launch-bound there: ~46x slower), plain
+    XLA elsewhere. Override with HOTSTUFF_MSM=pallas|xla."""
+    import os
+
+    pref = os.environ.get("HOTSTUFF_MSM", "auto")
+    # Pallas kernels are TPU-only (pltpu VMEM scratch); every other backend
+    # (cpu, gpu, ...) takes the portable XLA lowering.
+    use_pallas = pref == "pallas" or (
+        pref == "auto" and jax.default_backend() == "tpu"
+    )
+    if use_pallas:
+        from . import pallas_msm as pm
+
+        return pm.sqrt_pow, pm.msm
+    return None, cv.msm
+
+
 @functools.lru_cache(maxsize=16)
 def _compiled(m: int):
-    """Jitted decompress+MSM+cofactor-check for a padded lane count m."""
+    """Jitted unpack+decompress+MSM+cofactor-check for a padded lane count
+    m. Takes the single packed uint8 [m, 65] batch array."""
+    root_fn, msm_fn = _kernels()
 
     @jax.jit
-    def run(y_limbs, signs, digits):
-        ok, pts = cv.decompress(y_limbs, signs)
-        acc = cv.msm(pts, digits)
+    def run(packed):
+        y_limbs, signs, digits = _unpack_device(packed)
+        ok, pts = cv.decompress(y_limbs, signs, root_fn=root_fn)
+        acc = msm_fn(pts, digits)
         zero = cv.is_identity(cv.mul_by_cofactor(acc[None, ...]))[0]
         return jnp.all(ok) & zero
 
@@ -57,21 +115,12 @@ def _pad_to_pow2(n: int, minimum: int = 4) -> int:
     return m
 
 
-def _digits_np(scalar_bytes: np.ndarray) -> np.ndarray:
-    """uint8[m, 32] little-endian scalars -> int32[64, m] radix-16 digits,
-    MSB-first (vectorized host prep: ~µs for thousands of lanes)."""
-    low = (scalar_bytes & 0x0F).astype(np.int32)
-    high = (scalar_bytes >> 4).astype(np.int32)
-    lsb_first = np.empty((scalar_bytes.shape[0], 64), dtype=np.int32)
-    lsb_first[:, 0::2] = low
-    lsb_first[:, 1::2] = high
-    return lsb_first[:, ::-1].T.copy()  # MSB-first, [64, m]
-
-
 def prepare_batch(msgs, pubs, sigs, _rng=None):
-    """Host-side prep: strictness checks, challenges, RLC scalars, limb/digit
-    arrays. Returns (y_limbs, signs, digits, m_padded) or None if the batch
-    is rejected host-side."""
+    """Host-side prep: strictness checks, challenges, RLC scalars, and the
+    packed uint8 batch array. Returns ``(packed, m_padded)`` where
+    ``packed`` is uint8[m, 65] (bytes 0..31 point encoding with the sign
+    bit cleared, 32..63 scalar, 64 sign) — see ``_unpack_device`` — or
+    None if the batch is rejected host-side."""
     randbits = _rng.getrandbits if _rng is not None else secrets.randbits
 
     encodings: list[bytes] = []
@@ -104,31 +153,26 @@ def prepare_batch(msgs, pubs, sigs, _rng=None):
     encodings.extend([_IDENTITY_ENC] * pad)
     scalars.extend([0] * pad)
 
-    data = np.stack([np.frombuffer(e, dtype=np.uint8) for e in encodings])
-    signs = (data[:, 31] >> 7).astype(np.int32)
-    y_bytes = data.copy()
-    y_bytes[:, 31] &= 0x7F
-    y_limbs = fe.fe_from_bytes(y_bytes)
-    scalar_bytes = np.stack(
-        [np.frombuffer(s.to_bytes(32, "little"), dtype=np.uint8) for s in scalars]
-    )
-    digits = _digits_np(scalar_bytes)
-    return y_limbs, signs, digits, m
+    data = np.frombuffer(b"".join(encodings), dtype=np.uint8).reshape(-1, 32)
+    scalar_bytes = np.frombuffer(
+        b"".join(s.to_bytes(32, "little") for s in scalars), dtype=np.uint8
+    ).reshape(-1, 32)
+    packed = np.empty((m, 65), dtype=np.uint8)
+    packed[:, :32] = data
+    packed[:, 31] &= 0x7F  # sign bit moved to its own byte
+    packed[:, 32:64] = scalar_bytes
+    packed[:, 64] = data[:, 31] >> 7
+    return packed, m
 
 
-def pad_prepared(y_limbs, signs, digits, target: int):
-    """Grow a prepared batch to ``target`` lanes with identity encodings."""
-    m = y_limbs.shape[0]
+def pad_prepared(packed: np.ndarray, target: int):
+    """Grow a prepared batch to ``target`` lanes with identity encodings
+    (zero scalars)."""
+    m = packed.shape[0]
     extra = target - m
-    id_limbs = fe.fe_from_bytes(
-        np.frombuffer(_IDENTITY_ENC, dtype=np.uint8)[None, :]
-    )
-    y_limbs = np.concatenate([y_limbs, np.repeat(id_limbs, extra, axis=0)])
-    signs = np.concatenate([signs, np.zeros(extra, dtype=np.int32)])
-    digits = np.concatenate(
-        [digits, np.zeros((digits.shape[0], extra), dtype=np.int32)], axis=1
-    )
-    return y_limbs, signs, digits
+    pad = np.zeros((extra, 65), dtype=np.uint8)
+    pad[:, :32] = np.frombuffer(_IDENTITY_ENC, dtype=np.uint8)
+    return np.concatenate([packed, pad])
 
 
 def verify_batch_device(msgs, pubs, sigs, _rng=None) -> bool:
@@ -139,8 +183,5 @@ def verify_batch_device(msgs, pubs, sigs, _rng=None) -> bool:
     prepared = prepare_batch(msgs, pubs, sigs, _rng=_rng)
     if prepared is None:
         return False
-    y_limbs, signs, digits, m = prepared
-    result = _compiled(m)(
-        jnp.asarray(y_limbs), jnp.asarray(signs), jnp.asarray(digits)
-    )
-    return bool(result)
+    packed, m = prepared
+    return bool(_compiled(m)(jnp.asarray(packed)))

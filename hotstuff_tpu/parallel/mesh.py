@@ -82,13 +82,17 @@ def build_verifier(mesh: Mesh, m: int):
     @partial(
         shard_map,
         mesh=mesh,
-        in_specs=(P(AXIS, None), P(AXIS), P(None, AXIS)),
+        in_specs=(P(AXIS, None),),
         out_specs=P(),
         check_vma=False,  # result replicated by the explicit combine
     )
-    def run(y_limbs, signs, digits):
-        ok, pts = cv.decompress(y_limbs, signs)
-        acc = _combine_partials(cv.msm(pts, digits))
+    def run(packed):
+        from hotstuff_tpu.ops.verify import _kernels, _unpack_device
+
+        root_fn, msm_fn = _kernels()
+        y_limbs, signs, digits = _unpack_device(packed)
+        ok, pts = cv.decompress(y_limbs, signs, root_fn=root_fn)
+        acc = _combine_partials(msm_fn(pts, digits))
         all_ok = jax.lax.psum(jnp.all(ok).astype(jnp.int32), AXIS) == n_dev
         zero = cv.is_identity(cv.mul_by_cofactor(acc[None, ...]))[0]
         return all_ok & zero
@@ -106,7 +110,7 @@ def verify_batch_device_sharded(mesh: Mesh, msgs, pubs, sigs, _rng=None) -> bool
     prepared = v.prepare_batch(msgs, pubs, sigs, _rng=_rng)
     if prepared is None:
         return False
-    y_limbs, signs, digits, m = prepared
+    packed, m = prepared
     n_dev = mesh.devices.size
     # Round lanes up so each device gets an equal power-of-two shard.
     per_dev = max(4, -(-m // n_dev))
@@ -114,9 +118,9 @@ def verify_batch_device_sharded(mesh: Mesh, msgs, pubs, sigs, _rng=None) -> bool
         per_dev += 1
     target = per_dev * n_dev
     if target > m:
-        y_limbs, signs, digits = v.pad_prepared(y_limbs, signs, digits, target)
+        packed = v.pad_prepared(packed, target)
     run = _sharded_cache(mesh, target)
-    return bool(run(jnp.asarray(y_limbs), jnp.asarray(signs), jnp.asarray(digits)))
+    return bool(run(jnp.asarray(packed)))
 
 
 _VERIFIERS: dict = {}
