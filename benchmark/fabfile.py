@@ -1,0 +1,163 @@
+"""Harness entry points (reference ``benchmark/fabfile.py``): the same task
+set — local, remote, create, destroy, kill, plot, aggregate, logs — exposed
+both as plain functions (wrappable by fabric if present) and as a CLI:
+
+    python -m benchmark.fabfile local --nodes 4 --rate 1000
+    python -m benchmark.fabfile plot
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local import LocalBench  # noqa: E402
+from benchmark.logs import LogParser  # noqa: E402
+from benchmark.utils import PathMaker, Print  # noqa: E402
+
+
+def local(
+    nodes: int = 4,
+    rate: int = 1_000,
+    tx_size: int = 512,
+    duration: int = 20,
+    faults: int = 0,
+    timeout: int = 1_000,
+    batch_size: int = 15_000,
+    save: bool = False,
+):
+    """Local benchmark (reference defaults: 4 nodes, 1k tx/s, 512 B, 20 s,
+    1 s timeout, 15 kB batches — ``fabfile.py:12-38``)."""
+    bench = LocalBench(
+        nodes=nodes,
+        rate=rate,
+        tx_size=tx_size,
+        duration=duration,
+        faults=faults,
+        timeout_delay=timeout,
+        batch_size=batch_size,
+    )
+    parser = bench.run()
+    print(parser.result())
+    if save:
+        os.makedirs(PathMaker.results_path(), exist_ok=True)
+        parser.print_to(PathMaker.result_file(faults, nodes, rate, tx_size))
+    return parser
+
+
+def remote(hosts: list[str], rate: int = 10_000, tx_size: int = 512, duration: int = 60, faults: int = 0):
+    """Remote benchmark over SSH hosts (reference ``fabfile.py:96-122``)."""
+    from benchmark.remote import RemoteBench
+    from benchmark.settings import Settings
+
+    settings = Settings.load()
+    bench = RemoteBench(settings, hosts)
+    parser = bench.run(rate=rate, tx_size=tx_size, duration=duration, faults=faults)
+    print(parser.result())
+    return parser
+
+
+def create(instances: int = 2):
+    """Create AWS testbed instances (requires boto3)."""
+    from benchmark.instance import InstanceManager
+    from benchmark.settings import Settings
+
+    InstanceManager(Settings.load()).create(instances)
+
+
+def destroy():
+    from benchmark.instance import InstanceManager
+    from benchmark.settings import Settings
+
+    InstanceManager(Settings.load()).terminate()
+
+
+def kill(hosts: list[str]):
+    from benchmark.remote import RemoteBench
+    from benchmark.settings import Settings
+
+    RemoteBench(Settings.load(), hosts).kill()
+
+
+def logs(directory: str = "logs", faults: int = 0):
+    """Parse an existing log directory into a SUMMARY."""
+    parser = LogParser.process(directory, faults=faults)
+    print(parser.result())
+    return parser
+
+
+def aggregate(results_dir: str | None = None):
+    from benchmark.aggregate import LogAggregator
+
+    agg = LogAggregator(results_dir)
+    for path in agg.print_series():
+        Print.info(f"wrote {path}")
+
+
+def plot(results_dir: str | None = None, tx_size: int = 512):
+    from benchmark.plot import Ploter
+
+    ploter = Ploter(results_dir)
+    Print.info(f"wrote {ploter.plot_latency([0, 1, 3], [4, 10, 20, 50], tx_size)}")
+    Print.info(f"wrote {ploter.plot_tps([0], tx_size)}")
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(prog="benchmark.fabfile")
+    sub = p.add_subparsers(dest="task", required=True)
+
+    pl = sub.add_parser("local")
+    pl.add_argument("--nodes", type=int, default=4)
+    pl.add_argument("--rate", type=int, default=1_000)
+    pl.add_argument("--tx-size", type=int, default=512)
+    pl.add_argument("--duration", type=int, default=20)
+    pl.add_argument("--faults", type=int, default=0)
+    pl.add_argument("--timeout", type=int, default=1_000)
+    pl.add_argument("--save", action="store_true")
+
+    pr = sub.add_parser("remote")
+    pr.add_argument("--hosts", nargs="+", required=True)
+    pr.add_argument("--rate", type=int, default=10_000)
+    pr.add_argument("--tx-size", type=int, default=512)
+    pr.add_argument("--duration", type=int, default=60)
+    pr.add_argument("--faults", type=int, default=0)
+
+    pk = sub.add_parser("kill")
+    pk.add_argument("--hosts", nargs="+", required=True)
+
+    plog = sub.add_parser("logs")
+    plog.add_argument("--dir", default="logs")
+    plog.add_argument("--faults", type=int, default=0)
+
+    sub.add_parser("aggregate")
+    pp = sub.add_parser("plot")
+    pp.add_argument("--tx-size", type=int, default=512)
+
+    args = p.parse_args()
+    if args.task == "local":
+        local(
+            nodes=args.nodes,
+            rate=args.rate,
+            tx_size=args.tx_size,
+            duration=args.duration,
+            faults=args.faults,
+            timeout=args.timeout,
+            save=args.save,
+        )
+    elif args.task == "remote":
+        remote(args.hosts, args.rate, args.tx_size, args.duration, args.faults)
+    elif args.task == "kill":
+        kill(args.hosts)
+    elif args.task == "logs":
+        logs(args.dir, args.faults)
+    elif args.task == "aggregate":
+        aggregate()
+    elif args.task == "plot":
+        plot(tx_size=args.tx_size)
+
+
+if __name__ == "__main__":
+    main()

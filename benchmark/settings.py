@@ -1,0 +1,66 @@
+"""Testbed settings (reference ``benchmark/benchmark/settings.py``):
+same ``settings.json`` schema — testbed name, ssh key, port layout
+(consensus/mempool/front), repo, instance type, AWS regions."""
+
+from __future__ import annotations
+
+import json
+
+
+class SettingsError(Exception):
+    pass
+
+
+class Settings:
+    def __init__(
+        self,
+        testbed: str,
+        key_name: str,
+        key_path: str,
+        base_port: int,
+        repo_name: str,
+        repo_url: str,
+        branch: str,
+        instance_type: str,
+        aws_regions: list[str],
+    ) -> None:
+        self.testbed = testbed
+        self.key_name = key_name
+        self.key_path = key_path
+        self.base_port = base_port
+        self.repo_name = repo_name
+        self.repo_url = repo_url
+        self.branch = branch
+        self.instance_type = instance_type
+        self.aws_regions = aws_regions
+
+    @property
+    def consensus_port(self) -> int:
+        return self.base_port
+
+    @property
+    def mempool_port(self) -> int:
+        return self.base_port + 1_000
+
+    @property
+    def front_port(self) -> int:
+        return self.base_port + 2_000
+
+    @classmethod
+    def load(cls, filename: str = "settings.json") -> "Settings":
+        try:
+            with open(filename) as f:
+                data = json.load(f)
+            return cls(
+                testbed=data["testbed"],
+                key_name=data["key"]["name"],
+                key_path=data["key"]["path"],
+                base_port=int(data["ports"]["consensus"]),
+                repo_name=data["repo"]["name"],
+                repo_url=data["repo"]["url"],
+                branch=data["repo"]["branch"],
+                instance_type=data["instances"]["type"],
+                aws_regions=list(data["instances"]["regions"]),
+            )
+        except (OSError, KeyError, ValueError) as e:
+            raise SettingsError(f"failed to load settings '{filename}': {e}") from e

@@ -12,9 +12,13 @@ QC; at scale the verifier fuses QCs across rounds into super-batches.
 
 from __future__ import annotations
 
+import logging
+
 from .config import Committee, Round
 from .errors import AuthorityReuse
 from .messages import QC, TC, Timeout, Vote
+
+log = logging.getLogger("consensus")
 
 
 class QCMaker:
@@ -59,19 +63,72 @@ class Aggregator:
         self.votes_aggregators: dict[Round, dict] = {}
         self.timeouts_aggregators: dict[Round, TCMaker] = {}
 
+    # An honest round has exactly one proposal digest; 2N distinct digests
+    # per round is a generous bound that caps the memory an attacker can
+    # allocate per round (tightens the reference's open DoS caveat,
+    # ``aggregator.rs:29-30`` issue #7).
+    MAX_DIGESTS_PER_ROUND_FACTOR = 2
+
     def add_vote(self, vote: Vote) -> QC | None:
-        # NOTE: inherits the reference's DoS caveat (``aggregator.rs:29-30``):
-        # bounded by cleanup() per round advance.
-        return (
-            self.votes_aggregators.setdefault(vote.round, {})
-            .setdefault(vote.digest(), QCMaker())
-            .append(vote, self.committee)
-        )
+        per_round = self.votes_aggregators.setdefault(vote.round, {})
+        key = vote.digest()
+        if (
+            key not in per_round
+            and len(per_round)
+            >= self.MAX_DIGESTS_PER_ROUND_FACTOR * self.committee.size()
+        ):
+            log.warning(
+                "dropping vote for round %d: per-round digest bound reached",
+                vote.round,
+            )
+            return None
+        return per_round.setdefault(key, QCMaker()).append(vote, self.committee)
+
+    def stored_signature(self, round_: Round, digest, author):
+        """The signature currently held for (round, digest, author), if any."""
+        maker = self.votes_aggregators.get(round_, {}).get(digest)
+        if maker is None:
+            return None
+        for pk, sig in maker.votes:
+            if pk == author:
+                return sig
+        return None
 
     def add_timeout(self, timeout: Timeout) -> TC | None:
         return self.timeouts_aggregators.setdefault(
             timeout.round, TCMaker()
         ).append(timeout, self.committee)
+
+    def rebuild_votes(self, round_: Round, digest, good_votes, hash_) -> QC | None:
+        """After a batch-verified QC failed, reinstate only the good votes
+        for (round, block digest) so aggregation continues; ejected authors
+        may vote again (their next signature may be honest).
+
+        With unequal stakes the surviving votes may already meet the quorum
+        threshold (the bad vote was not load-bearing): emit that QC now —
+        its signatures were individually verified during ejection — instead
+        of stalling on a vote that may never come."""
+        maker = QCMaker()
+        maker.votes = list(good_votes)
+        maker.used = {pk for pk, _ in good_votes}
+        maker.weight = sum(self.committee.stake(pk) for pk, _ in good_votes)
+        self.votes_aggregators.setdefault(round_, {})[digest] = maker
+        if maker.weight >= self.committee.quorum_threshold():
+            maker.weight = 0  # QC emitted exactly once
+            return QC(hash=hash_, round=round_, votes=list(maker.votes))
+        return None
+
+    def replace_vote(self, vote: Vote) -> None:
+        """Swap an author's stored (unverified) vote for a newly verified
+        one — the anti-displacement path of batched verification."""
+        makers = self.votes_aggregators.get(vote.round, {})
+        maker = makers.get(vote.digest())
+        if maker is None or vote.author not in maker.used:
+            return
+        maker.votes = [
+            (pk, sig) if pk != vote.author else (pk, vote.signature)
+            for pk, sig in maker.votes
+        ]
 
     def cleanup(self, round_: Round) -> None:
         self.votes_aggregators = {

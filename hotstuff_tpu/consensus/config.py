@@ -26,6 +26,11 @@ class Parameters:
     # loss, at ~ms extra latency per vote. Off by default (process-crash
     # safety only), matching typical BFT deployment practice.
     persist_sync: bool = False
+    # Committee-scale vote handling: accumulate unverified votes and
+    # batch-verify the assembled QC's 2f+1 signatures in one crypto call
+    # (byzantine signatures are identified and ejected on failure). Pairs
+    # with the TPU crypto backend; worthwhile from ~100 validators.
+    batch_vote_verification: bool = False
 
     def log(self) -> None:
         # Picked up by the benchmark log parser (reference ``config.rs:25-31``).

@@ -112,6 +112,7 @@ class Consensus:
                 tx_commit,
                 benchmark=benchmark,
                 persist_sync=parameters.persist_sync,
+                batch_vote_verification=parameters.batch_vote_verification,
             )
         )
         self.tasks.append(

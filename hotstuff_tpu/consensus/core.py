@@ -30,9 +30,12 @@ from hotstuff_tpu.network import SimpleSender
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.serde import Decoder, Encoder
 
+from hotstuff_tpu.crypto import CryptoError
+
 from .aggregator import Aggregator
 from .config import Committee, Round
-from .errors import ConsensusError, WrongLeader
+from .crypto_bridge import verify_off_loop
+from .errors import ConsensusError, UnknownAuthority, WrongLeader
 from .leader import LeaderElector
 from .mempool_driver import MempoolDriver
 from .messages import (
@@ -72,6 +75,7 @@ class Core:
         tx_commit: asyncio.Queue,
         benchmark: bool = False,
         persist_sync: bool = False,
+        batch_vote_verification: bool = False,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -86,6 +90,7 @@ class Core:
         self.tx_commit = tx_commit
         self.benchmark = benchmark
         self.persist_sync = persist_sync
+        self.batch_vote_verification = batch_vote_verification
         self.round: Round = 1
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
@@ -93,6 +98,9 @@ class Core:
         self.timer = Timer(timeout_delay)
         self.aggregator = Aggregator(committee)
         self.network = SimpleSender()
+        # round -> set of known-byzantine vote keys (author||sig||hash);
+        # GC'd with the aggregator on round advance.
+        self._bad_sigs: dict[Round, set[bytes]] = {}
 
     @classmethod
     def spawn(cls, *args, **kwargs) -> asyncio.Task:
@@ -192,23 +200,100 @@ class Core:
 
     # -- handlers -----------------------------------------------------------
 
+    # Votes beyond this many rounds ahead are dropped: bounds the state an
+    # attacker can allocate for fabricated future rounds.
+    MAX_ROUND_LOOKAHEAD = 1_000
+
     async def handle_vote(self, vote: Vote) -> None:
         log.debug("Processing %r", vote)
         if vote.round < self.round:
             return
-        vote.verify(self.committee)
-        qc = self.aggregator.add_vote(vote)
+        if vote.round > self.round + self.MAX_ROUND_LOOKAHEAD:
+            log.warning("dropping vote %d rounds ahead", vote.round - self.round)
+            return
+        if self.batch_vote_verification:
+            qc = await self._handle_vote_batched(vote)
+        else:
+            await verify_off_loop(vote.verify, self.committee)
+            qc = self.aggregator.add_vote(vote)
         if qc is not None:
             log.debug("Assembled %r", qc)
             await self.process_qc(qc)
             if self.name == self.leader_elector.get_leader(self.round):
                 await self.generate_proposal(None)
 
+    async def _handle_vote_batched(self, vote: Vote) -> QC | None:
+        """Committee-scale path: only cheap checks per vote; the 2f+1
+        signatures of the assembled QC are verified in ONE batch call (one
+        device dispatch per QC instead of per vote)."""
+        if self.committee.stake(vote.author) == 0:
+            raise UnknownAuthority(str(vote.author))
+        if self._vote_key(vote) in self._bad_sigs.get(vote.round, set()):
+            return None  # known-byzantine signature resent: drop cheaply
+        try:
+            qc = self.aggregator.add_vote(vote)
+        except ConsensusError:
+            # The author's slot is taken — possibly by a spoofed vote that
+            # would otherwise displace the honest one. Identical resends
+            # drop free; a DIFFERENT signature is verified individually and
+            # swapped in if genuine, preserving liveness under spoofing.
+            stored = self.aggregator.stored_signature(
+                vote.round, vote.digest(), vote.author
+            )
+            if stored == vote.signature:
+                return None
+            try:
+                await verify_off_loop(vote.verify, self.committee)
+            except ConsensusError:
+                self._record_bad(vote.round, self._vote_key(vote))
+                return None
+            self.aggregator.replace_vote(vote)
+            return None
+        if qc is None:
+            return None
+        try:
+            await verify_off_loop(qc.verify, self.committee)
+            return qc
+        except ConsensusError:
+            return await self._eject_invalid_votes(qc)
+
+    async def _eject_invalid_votes(self, qc: QC) -> QC | None:
+        """A batch-verified QC failed: identify the byzantine signatures
+        (off the event loop — this is 2f+1 serial verifies), record them so
+        resends drop cheaply, and keep the good votes aggregating. Returns
+        a QC if the surviving votes already meet the quorum threshold."""
+        digest = qc.digest()
+
+        def split():
+            good, bad = [], []
+            for pk, sig in qc.votes:
+                try:
+                    sig.verify(digest, pk)
+                    good.append((pk, sig))
+                except CryptoError:
+                    bad.append((pk, sig))
+            return good, bad
+
+        good, bad = await verify_off_loop(split)
+        for pk, sig in bad:
+            log.warning("ejecting invalid vote signature from %s", pk)
+            self._record_bad(
+                qc.round, bytes(pk.data) + sig.data + qc.hash.data
+            )
+        return self.aggregator.rebuild_votes(qc.round, digest, good, qc.hash)
+
+    @staticmethod
+    def _vote_key(vote: Vote) -> bytes:
+        return vote.author.data + vote.signature.data + vote.hash.data
+
+    def _record_bad(self, round_: Round, key: bytes) -> None:
+        self._bad_sigs.setdefault(round_, set()).add(key)
+
     async def handle_timeout(self, timeout: Timeout) -> None:
         log.debug("Processing %r", timeout)
         if timeout.round < self.round:
             return
-        timeout.verify(self.committee)
+        await verify_off_loop(timeout.verify, self.committee)
         await self.process_qc(timeout.high_qc)
         tc = self.aggregator.add_timeout(timeout)
         if tc is not None:
@@ -226,6 +311,7 @@ class Core:
         self.round = round_ + 1
         log.debug("Moved to round %d", self.round)
         self.aggregator.cleanup(self.round)
+        self._bad_sigs = {r: s for r, s in self._bad_sigs.items() if r >= self.round}
 
     async def generate_proposal(self, tc: TC | None) -> None:
         await self.tx_proposer.put(ProposerMake(self.round, self.high_qc, tc))
@@ -279,7 +365,7 @@ class Core:
             raise WrongLeader(
                 f"block {digest} from {block.author} at round {block.round}"
             )
-        block.verify(self.committee)
+        await verify_off_loop(block.verify, self.committee)
         await self.process_qc(block.qc)
         if block.tc is not None:
             await self.advance_round(block.tc.round)
@@ -289,7 +375,7 @@ class Core:
         await self.process_block(block)
 
     async def handle_tc(self, tc: TC) -> None:
-        tc.verify(self.committee)
+        await verify_off_loop(tc.verify, self.committee)
         if tc.round < self.round:
             return
         await self.advance_round(tc.round)

@@ -1,0 +1,46 @@
+"""Async bridge between the consensus event loop and the crypto plane.
+
+The Core's select loop must never block on signature verification: a QC
+verification is a batch crypto call that — on the TPU backend — involves a
+host->device round trip. The bridge runs verifications on a small worker
+pool and the Core awaits them, so network handling, timeouts, and other
+protocol work continue while the device (or CPU) verifies.
+
+This is the "tokio <-> device dispatch without head-of-line blocking"
+component called out in SURVEY.md §7; the reference has no equivalent
+because its crypto is synchronous ed25519-dalek on the calling thread.
+
+Batched vote verification (``BatchedVoteVerifier``) is the committee-scale
+design (BASELINE.json configs 2-4): instead of verifying each incoming
+vote individually (2f+1 sequential verifies per round), votes pass only
+cheap stake/round checks on arrival, accumulate in the aggregator, and the
+assembled QC's 2f+1 signatures are verified in ONE batch call. If the
+batch fails, the byzantine signatures are identified individually and
+ejected, and the aggregator keeps collecting.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import logging
+from concurrent.futures import ThreadPoolExecutor
+
+log = logging.getLogger("consensus")
+
+_EXECUTOR: ThreadPoolExecutor | None = None
+
+
+def _executor() -> ThreadPoolExecutor:
+    global _EXECUTOR
+    if _EXECUTOR is None:
+        # 2 workers: one verification in flight while the next batch's host
+        # prep runs — matches the device pipeline depth that saturates it.
+        _EXECUTOR = ThreadPoolExecutor(max_workers=2, thread_name_prefix="crypto")
+    return _EXECUTOR
+
+
+async def verify_off_loop(verify_fn, *args):
+    """Run a blocking verification callable off the event loop; re-raises
+    its exception (ConsensusError/CryptoError) in the awaiting task."""
+    loop = asyncio.get_running_loop()
+    return await loop.run_in_executor(_executor(), lambda: verify_fn(*args))

@@ -166,6 +166,9 @@ class Parameters:
                     timeout_delay=int(c.get("timeout_delay", 5_000)),
                     sync_retry_delay=int(c.get("sync_retry_delay", 10_000)),
                     persist_sync=bool(c.get("persist_sync", False)),
+                    batch_vote_verification=bool(
+                        c.get("batch_vote_verification", False)
+                    ),
                 ),
                 MempoolParameters(
                     gc_depth=int(m.get("gc_depth", 50)),

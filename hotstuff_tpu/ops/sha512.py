@@ -1,0 +1,228 @@
+"""Batched SHA-512 on device.
+
+64-bit words are emulated as (hi, lo) uint32 pairs — the TPU has no native
+64-bit integer path. The batch dimension (many messages hashed in
+parallel) is the lane axis; blocks chain through a ``lax.scan``; the 80
+rounds and message-schedule extension are unrolled in the scan body.
+
+Protocol fit (reference uses SHA-512 truncated to 32 B for every digest,
+``crypto/src/lib.rs``, ``mempool/src/processor.rs:30``): the host keeps
+hashlib for latency-bound single digests; this kernel serves
+throughput-bound regimes — thousands of per-signature challenge hashes or
+batch digests at committee scale (BASELINE.json config 3).
+
+Bit-exact against hashlib (property-tested).
+"""
+
+from __future__ import annotations
+
+import functools
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+# Round constants (FIPS 180-4) as (hi, lo) uint32.
+_K64 = [
+    0x428A2F98D728AE22, 0x7137449123EF65CD, 0xB5C0FBCFEC4D3B2F, 0xE9B5DBA58189DBBC,
+    0x3956C25BF348B538, 0x59F111F1B605D019, 0x923F82A4AF194F9B, 0xAB1C5ED5DA6D8118,
+    0xD807AA98A3030242, 0x12835B0145706FBE, 0x243185BE4EE4B28C, 0x550C7DC3D5FFB4E2,
+    0x72BE5D74F27B896F, 0x80DEB1FE3B1696B1, 0x9BDC06A725C71235, 0xC19BF174CF692694,
+    0xE49B69C19EF14AD2, 0xEFBE4786384F25E3, 0x0FC19DC68B8CD5B5, 0x240CA1CC77AC9C65,
+    0x2DE92C6F592B0275, 0x4A7484AA6EA6E483, 0x5CB0A9DCBD41FBD4, 0x76F988DA831153B5,
+    0x983E5152EE66DFAB, 0xA831C66D2DB43210, 0xB00327C898FB213F, 0xBF597FC7BEEF0EE4,
+    0xC6E00BF33DA88FC2, 0xD5A79147930AA725, 0x06CA6351E003826F, 0x142929670A0E6E70,
+    0x27B70A8546D22FFC, 0x2E1B21385C26C926, 0x4D2C6DFC5AC42AED, 0x53380D139D95B3DF,
+    0x650A73548BAF63DE, 0x766A0ABB3C77B2A8, 0x81C2C92E47EDAEE6, 0x92722C851482353B,
+    0xA2BFE8A14CF10364, 0xA81A664BBC423001, 0xC24B8B70D0F89791, 0xC76C51A30654BE30,
+    0xD192E819D6EF5218, 0xD69906245565A910, 0xF40E35855771202A, 0x106AA07032BBD1B8,
+    0x19A4C116B8D2D0C8, 0x1E376C085141AB53, 0x2748774CDF8EEB99, 0x34B0BCB5E19B48A8,
+    0x391C0CB3C5C95A63, 0x4ED8AA4AE3418ACB, 0x5B9CCA4F7763E373, 0x682E6FF3D6B2B8A3,
+    0x748F82EE5DEFB2FC, 0x78A5636F43172F60, 0x84C87814A1F0AB72, 0x8CC702081A6439EC,
+    0x90BEFFFA23631E28, 0xA4506CEBDE82BDE9, 0xBEF9A3F7B2C67915, 0xC67178F2E372532B,
+    0xCA273ECEEA26619C, 0xD186B8C721C0C207, 0xEADA7DD6CDE0EB1E, 0xF57D4F7FEE6ED178,
+    0x06F067AA72176FBA, 0x0A637DC5A2C898A6, 0x113F9804BEF90DAE, 0x1B710B35131C471B,
+    0x28DB77F523047D84, 0x32CAAB7B40C72493, 0x3C9EBE0A15C9BEBC, 0x431D67C49C100D4C,
+    0x4CC5D4BECB3E42B6, 0x597F299CFC657E2A, 0x5FCB6FAB3AD6FAEC, 0x6C44198C4A475817,
+]
+_H0 = [
+    0x6A09E667F3BCC908, 0xBB67AE8584CAA73B, 0x3C6EF372FE94F82B, 0xA54FF53A5F1D36F1,
+    0x510E527FADE682D1, 0x9B05688C2B3E6C1F, 0x1F83D9ABFB41BD6B, 0x5BE0CD19137E2179,
+]
+
+_K_HI = np.array([k >> 32 for k in _K64], dtype=np.uint32)
+_K_LO = np.array([k & 0xFFFFFFFF for k in _K64], dtype=np.uint32)
+_H0_HI = np.array([h >> 32 for h in _H0], dtype=np.uint32)
+_H0_LO = np.array([h & 0xFFFFFFFF for h in _H0], dtype=np.uint32)
+
+
+# -- 64-bit ops on (hi, lo) uint32 pairs -----------------------------------
+
+
+def _add(a, b):
+    lo = a[1] + b[1]
+    carry = (lo < a[1]).astype(jnp.uint32)
+    return (a[0] + b[0] + carry, lo)
+
+
+def _xor(a, b):
+    return (a[0] ^ b[0], a[1] ^ b[1])
+
+
+def _and(a, b):
+    return (a[0] & b[0], a[1] & b[1])
+
+
+def _not(a):
+    return (~a[0], ~a[1])
+
+
+def _rotr(a, n):
+    hi, lo = a
+    if n == 32:
+        return (lo, hi)
+    if n > 32:
+        hi, lo = lo, hi
+        n -= 32
+    n = jnp.uint32(n)
+    m = jnp.uint32(32) - n
+    return ((hi >> n) | (lo << m), (lo >> n) | (hi << m))
+
+
+def _shr(a, n):
+    hi, lo = a
+    assert 0 < n < 32
+    n = jnp.uint32(n)
+    m = jnp.uint32(32) - n
+    return (hi >> n, (lo >> n) | (hi << m))
+
+
+def _compress(state, block):
+    """One SHA-512 compression: state 8x(hi,lo) [lanes], block 16x(hi,lo).
+
+    Both the message-schedule extension and the 80 rounds run as lax.scans
+    (a 16-slot rolling window for the schedule) — unrolling them produced
+    multi-minute XLA compiles.
+    """
+    ring_hi = jnp.stack([w[0] for w in block])  # [16, lanes]
+    ring_lo = jnp.stack([w[1] for w in block])
+
+    def extend(ring, _):
+        rhi, rlo = ring
+        w15 = (rhi[1], rlo[1])  # t-15
+        w7 = (rhi[9], rlo[9])  # t-7
+        w2 = (rhi[14], rlo[14])  # t-2
+        w16 = (rhi[0], rlo[0])  # t-16
+        s0 = _xor(_xor(_rotr(w15, 1), _rotr(w15, 8)), _shr(w15, 7))
+        s1 = _xor(_xor(_rotr(w2, 19), _rotr(w2, 61)), _shr(w2, 6))
+        new = _add(_add(w16, s0), _add(w7, s1))
+        rhi = jnp.concatenate([rhi[1:], new[0][None]])
+        rlo = jnp.concatenate([rlo[1:], new[1][None]])
+        return (rhi, rlo), new
+
+    _, extended = lax.scan(extend, (ring_hi, ring_lo), None, length=64)
+    w_hi = jnp.concatenate([ring_hi, extended[0]])  # [80, lanes]
+    w_lo = jnp.concatenate([ring_lo, extended[1]])
+
+    def round_step(carry, inputs):
+        a, b, c, d, e, f, g, h = carry
+        k_hi, k_lo, wt_hi, wt_lo = inputs
+        k = (k_hi, k_lo)
+        wt = (wt_hi, wt_lo)
+        s1 = _xor(_xor(_rotr(e, 14), _rotr(e, 18)), _rotr(e, 41))
+        ch = _xor(_and(e, f), _and(_not(e), g))
+        t1 = _add(_add(_add(h, s1), _add(ch, k)), wt)
+        s0 = _xor(_xor(_rotr(a, 28), _rotr(a, 34)), _rotr(a, 39))
+        maj = _xor(_xor(_and(a, b), _and(a, c)), _and(b, c))
+        t2 = _add(s0, maj)
+        return (_add(t1, t2), a, b, c, _add(d, t1), e, f, g), None
+
+    k_hi = jnp.asarray(_K_HI)[:, None] + jnp.zeros_like(w_hi)
+    k_lo = jnp.asarray(_K_LO)[:, None] + jnp.zeros_like(w_lo)
+    final, _ = lax.scan(round_step, state, (k_hi, k_lo, w_hi, w_lo))
+    return tuple(_add(s, n) for s, n in zip(state, final))
+
+
+@functools.lru_cache(maxsize=8)
+def _compiled(nblocks: int):
+    @jax.jit
+    def run(blocks_hi, blocks_lo):  # [n, nblocks, 16] uint32 each
+        n = blocks_hi.shape[0]
+        state = tuple(
+            (
+                jnp.full((n,), np.uint32(_H0_HI[i]), dtype=jnp.uint32),
+                jnp.full((n,), np.uint32(_H0_LO[i]), dtype=jnp.uint32),
+            )
+            for i in range(8)
+        )
+
+        def body(st, blk):
+            bhi, blo = blk  # [n, 16]
+            words = tuple((bhi[:, j], blo[:, j]) for j in range(16))
+            return _compress(st, words), None
+
+        state, _ = lax.scan(
+            body,
+            state,
+            (jnp.moveaxis(blocks_hi, 1, 0), jnp.moveaxis(blocks_lo, 1, 0)),
+        )
+        # [n, 8] hi/lo -> caller assembles bytes.
+        return (
+            jnp.stack([s[0] for s in state], axis=1),
+            jnp.stack([s[1] for s in state], axis=1),
+        )
+
+    return run
+
+
+def _pad_messages(msgs: list[bytes]) -> tuple[np.ndarray, np.ndarray]:
+    """FIPS 180-4 padding; all messages must produce the same block count."""
+    length = len(msgs[0])
+    assert all(len(m) == length for m in msgs), "equal-length batches only"
+    total = length + 17  # 0x80 + 16-byte length field
+    nblocks = -(-total // 128)
+    padded = np.zeros((len(msgs), nblocks * 128), dtype=np.uint8)
+    data = np.frombuffer(b"".join(msgs), dtype=np.uint8).reshape(len(msgs), length)
+    padded[:, :length] = data
+    padded[:, length] = 0x80
+    bitlen = length * 8
+    padded[:, -16:] = np.frombuffer(
+        bitlen.to_bytes(16, "big"), dtype=np.uint8
+    )
+    # Big-endian 64-bit words as (hi, lo) uint32.
+    words = padded.reshape(len(msgs), nblocks, 16, 8)
+    hi = (
+        (words[..., 0].astype(np.uint32) << 24)
+        | (words[..., 1].astype(np.uint32) << 16)
+        | (words[..., 2].astype(np.uint32) << 8)
+        | words[..., 3].astype(np.uint32)
+    )
+    lo = (
+        (words[..., 4].astype(np.uint32) << 24)
+        | (words[..., 5].astype(np.uint32) << 16)
+        | (words[..., 6].astype(np.uint32) << 8)
+        | words[..., 7].astype(np.uint32)
+    )
+    return hi, lo
+
+
+def sha512_batch(msgs: list[bytes]) -> list[bytes]:
+    """SHA-512 of equal-length messages, batched on device."""
+    hi, lo = _pad_messages(msgs)
+    out_hi, out_lo = _compiled(hi.shape[1])(jnp.asarray(hi), jnp.asarray(lo))
+    out_hi = np.asarray(out_hi)
+    out_lo = np.asarray(out_lo)
+    n = len(msgs)
+    out = np.zeros((n, 8, 8), dtype=np.uint8)
+    for shift, idx in ((24, 0), (16, 1), (8, 2), (0, 3)):
+        out[:, :, idx] = (out_hi >> shift).astype(np.uint8)
+        out[:, :, idx + 4] = (out_lo >> shift).astype(np.uint8)
+    return [bytes(row.reshape(64)) for row in out]
+
+
+def sha512_32_batch(msgs: list[bytes]) -> list[bytes]:
+    """Protocol digests: SHA-512 truncated to 32 bytes (reference digest
+    convention)."""
+    return [d[:32] for d in sha512_batch(msgs)]

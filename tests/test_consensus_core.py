@@ -24,7 +24,7 @@ from .common import async_test, chain, consensus_committee, keys, listener
 BASE = 13100
 
 
-def spawn_core(name_idx: int, committee, store=None, timeout_delay=10_000):
+def spawn_core(name_idx: int, committee, store=None, timeout_delay=10_000, **core_kwargs):
     """Wire a Core with real channels; returns the handles a test needs."""
     pk, sk = keys()[name_idx]
     store = store or Store()
@@ -46,6 +46,7 @@ def spawn_core(name_idx: int, committee, store=None, timeout_delay=10_000):
         tx_loopback,
         tx_proposer,
         tx_commit,
+        **core_kwargs,
     )
     return {
         "pk": pk,

@@ -1,0 +1,94 @@
+"""Benchmark harness tests: aggregation and plotting from result files
+(the LocalBench E2E flow is exercised by the driver/verify runs — booting
+real process committees is too heavy for the unit suite)."""
+
+import os
+
+from benchmark.aggregate import LogAggregator, Setup
+from benchmark.logs import LogParser
+
+SUMMARY_TEMPLATE = """
+-----------------------------------------
+ SUMMARY:
+-----------------------------------------
+ + CONFIG:
+ Faults: {faults} nodes
+ Committee size: {nodes} nodes
+ Input rate: {rate:,} tx/s
+ Transaction size: 512 B
+ Execution time: 20 s
+
+ Consensus timeout delay: 1,000 ms
+ Consensus sync retry delay: 10,000 ms
+ Mempool GC depth: 50 rounds
+ Mempool sync retry delay: 5,000 ms
+ Mempool sync retry nodes: 3 nodes
+ Mempool batch size: 15,000 B
+ Mempool max batch delay: 10 ms
+
+ + RESULTS:
+ Consensus TPS: {tps:,} tx/s
+ Consensus BPS: 495,294 B/s
+ Consensus latency: 2 ms
+
+ End-to-end TPS: {tps:,} tx/s
+ End-to-end BPS: 491,691 B/s
+ End-to-end latency: {latency:,} ms
+-----------------------------------------
+"""
+
+
+def _write_results(tmp_path):
+    cases = [
+        (0, 4, 1_000, 960, 31),
+        (0, 4, 1_000, 940, 35),  # second run of the same setup
+        (0, 4, 2_000, 1_800, 60),
+        (1, 4, 1_000, 600, 1_000),
+    ]
+    for faults, nodes, rate, tps, latency in cases:
+        path = tmp_path / f"bench-{faults}-{nodes}-{rate}-512.txt"
+        with open(path, "a") as f:
+            f.write(
+                SUMMARY_TEMPLATE.format(
+                    faults=faults, nodes=nodes, rate=rate, tps=tps, latency=latency
+                )
+            )
+    return str(tmp_path)
+
+
+def test_aggregator_mean_std(tmp_path):
+    agg = LogAggregator(_write_results(tmp_path))
+    series = agg.latency_vs_rate(faults=0, nodes=4, tx_size=512)
+    assert len(series) == 2
+    rate, tps, tps_std, lat, lat_std = series[0]
+    assert rate == 1_000 and tps == 950 and lat == 33
+    assert tps_std > 0
+    assert series[1][0] == 2_000
+
+
+def test_aggregator_tps_vs_nodes(tmp_path):
+    agg = LogAggregator(_write_results(tmp_path))
+    rows = agg.tps_vs_nodes(faults=0, tx_size=512)
+    assert rows == [(4, 1800.0, 0)]
+    capped = agg.tps_vs_nodes(faults=0, tx_size=512, max_latency=50)
+    assert capped[0][1] == 950.0  # 2k-rate point excluded by latency cap
+
+
+def test_plots_render(tmp_path):
+    from benchmark.plot import Ploter
+
+    results = _write_results(tmp_path)
+    ploter = Ploter(results)
+    out1 = ploter.plot_latency([0, 1], [4], 512, out=str(tmp_path / "lat.pdf"))
+    out2 = ploter.plot_tps([0], 512, out=str(tmp_path / "tps.pdf"))
+    assert os.path.getsize(out1) > 1_000
+    assert os.path.getsize(out2) > 1_000
+
+
+def test_log_parser_rejects_panics(tmp_path):
+    import pytest
+
+    from benchmark.logs import ParseError
+
+    with pytest.raises(ParseError):
+        LogParser(["Traceback (most recent call last):"], ["x"], 0)

@@ -1,0 +1,35 @@
+"""Device SHA-512 vs hashlib (bit-exactness property tests)."""
+
+import hashlib
+import random
+
+import pytest
+
+pytest.importorskip("jax")
+
+from hotstuff_tpu.ops.sha512 import sha512_32_batch, sha512_batch  # noqa: E402
+
+rng = random.Random(99)
+
+
+@pytest.mark.parametrize("length", [0, 1, 32, 96, 111, 112, 127, 128, 300])
+def test_matches_hashlib(length):
+    msgs = [rng.randbytes(length) for _ in range(4)]
+    got = sha512_batch(msgs)
+    for m, d in zip(msgs, got):
+        assert d == hashlib.sha512(m).digest(), f"length {length}"
+
+
+def test_protocol_digest_truncation():
+    msgs = [b"batch-bytes" * 10] * 3
+    got = sha512_32_batch(msgs)
+    assert got[0] == hashlib.sha512(msgs[0]).digest()[:32]
+
+
+def test_challenge_hash_shape():
+    """The verifier's h = SHA512(R||A||M) input is 96 bytes — one block."""
+    msgs = [rng.randbytes(96) for _ in range(8)]
+    got = sha512_batch(msgs)
+    assert all(
+        d == hashlib.sha512(m).digest() for m, d in zip(msgs, got)
+    )
