@@ -15,7 +15,7 @@ import glob
 import os
 from collections import defaultdict
 from dataclasses import dataclass, field
-from re import findall, search
+from re import search
 from statistics import mean, stdev
 
 from .utils import PathMaker

@@ -1,0 +1,107 @@
+"""Committee-scale consensus benchmark (BASELINE.json configs 2-4).
+
+Boots an N-validator committee of full consensus engines IN ONE PROCESS
+(mempool channels sunk, like the reference's `node deploy` testbed) with
+``batch_vote_verification`` on, and measures round rate and QC sizes under
+the selected crypto backend:
+
+    python -m benchmark.committee_scale --nodes 20 --rounds 20
+    HOTSTUFF_CRYPTO_BACKEND=tpu python -m benchmark.committee_scale --nodes 20
+
+At committee scale the per-round cost is dominated by QC verification
+(every validator batch-verifies the 2f+1 signatures embedded in each
+proposal): the point of the TPU backend. All N validators share one event
+loop and one CPU core here, so absolute round rates are a lower bound; the
+relevant comparison is cpu-backend vs tpu-backend at the same N.
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+async def run_committee(n: int, rounds_target: int, base_port: int, timeout_delay: int):
+    from hotstuff_tpu.consensus import Authority, Committee, Consensus, Parameters
+    from hotstuff_tpu.crypto import SignatureService, generate_keypair
+    from hotstuff_tpu.store import Store
+
+    keys = [generate_keypair() for _ in range(n)]
+    committee = Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", base_port + i))
+            for i, (pk, _) in enumerate(keys)
+        }
+    )
+    params = Parameters(
+        timeout_delay=timeout_delay, batch_vote_verification=True
+    )
+
+    engines, commits, sinks = [], [], []
+    for pk, sk in keys:
+        rx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_commit: asyncio.Queue = asyncio.Queue()
+
+        async def drain(q=tx_mempool):
+            while True:
+                await q.get()
+
+        sinks.append(asyncio.create_task(drain()))
+        engines.append(
+            await Consensus.spawn(
+                pk,
+                committee,
+                params,
+                SignatureService(sk),
+                Store(),
+                rx_mempool,
+                tx_mempool,
+                tx_commit,
+            )
+        )
+        commits.append(tx_commit)
+
+    # Wait for the first commit everywhere, then time rounds_target more.
+    await asyncio.gather(*[q.get() for q in commits])
+    t0 = time.perf_counter()
+    for _ in range(rounds_target):
+        await asyncio.gather(*[q.get() for q in commits])
+    elapsed = time.perf_counter() - t0
+
+    for e in engines:
+        await e.shutdown()
+    for s in sinks:
+        s.cancel()
+    return elapsed / rounds_target
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=20)
+    p.add_argument("--rounds", type=int, default=20)
+    p.add_argument("--base-port", type=int, default=17000)
+    p.add_argument("--timeout", type=int, default=30_000)
+    args = p.parse_args()
+
+    from hotstuff_tpu.crypto import get_backend
+
+    backend = get_backend().name
+    f = (args.nodes - 1) // 3
+    per_round = asyncio.run(
+        run_committee(args.nodes, args.rounds, args.base_port, args.timeout)
+    )
+    print(
+        f"committee={args.nodes} (f={f}, QC size {2 * f + 1}) "
+        f"backend={backend} batch_votes=on: "
+        f"{per_round * 1e3:.1f} ms/round ({1 / per_round:.2f} rounds/s)"
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import random
 from collections import deque
 
 from .receiver import read_frame, write_frame
@@ -96,6 +97,7 @@ class _Connection:
 class ReliableSender:
     def __init__(self) -> None:
         self._connections: dict[tuple[str, int], _Connection] = {}
+        self._rng = random.Random()
 
     def _connection(self, address: tuple[str, int]) -> _Connection:
         conn = self._connections.get(address)
@@ -120,6 +122,14 @@ class ReliableSender:
         self, addresses: list[tuple[str, int]], data: bytes
     ) -> list[CancelHandler]:
         return [self.send(addr, data) for addr in addresses]
+
+    def lucky_broadcast(
+        self, addresses: list[tuple[str, int]], data: bytes, nodes: int
+    ) -> list[CancelHandler]:
+        """Reliably send to ``nodes`` randomly-picked addresses (reference
+        ``reliable_sender.rs:91-100``)."""
+        picked = self._rng.sample(addresses, min(nodes, len(addresses)))
+        return [self.send(addr, data) for addr in picked]
 
     def shutdown(self) -> None:
         for conn in self._connections.values():

@@ -16,8 +16,20 @@ BASE = 13300
 
 @async_test
 async def test_end_to_end_four_nodes():
-    committee = consensus_committee(BASE)
-    params = Parameters(timeout_delay=2_000)
+    await _run_e2e(BASE, Parameters(timeout_delay=2_000))
+
+
+@async_test
+async def test_end_to_end_with_batched_vote_verification():
+    """The committee-scale vote path (accumulate-then-batch-verify) must
+    sustain live consensus across a real 4-node committee."""
+    await _run_e2e(
+        BASE + 20, Parameters(timeout_delay=2_000, batch_vote_verification=True)
+    )
+
+
+async def _run_e2e(base_port: int, params: Parameters):
+    committee = consensus_committee(base_port)
 
     engines = []
     commits = []

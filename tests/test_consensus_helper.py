@@ -1,0 +1,46 @@
+"""Consensus Helper: serves SyncRequests with full Propose replies
+(reference ``consensus/src/tests/helper_tests.rs``)."""
+
+import asyncio
+
+from hotstuff_tpu.consensus.helper import Helper
+from hotstuff_tpu.consensus.messages import decode_message
+from hotstuff_tpu.store import Store
+
+from .common import async_test, chain, consensus_committee, keys, listener
+
+BASE = 15500
+
+
+@async_test
+async def test_helper_serves_stored_block():
+    committee = consensus_committee(BASE)
+    store = Store()
+    block = chain(1)[0]
+    await store.write(block.digest().data, block.serialize())
+
+    rx: asyncio.Queue = asyncio.Queue()
+    Helper.spawn(committee, store, rx)
+
+    requestor = keys()[1][0]
+    task = asyncio.create_task(listener(committee.address(requestor)[1]))
+    await asyncio.sleep(0.05)
+    await rx.put((block.digest(), requestor))
+    frame = await asyncio.wait_for(task, 5)
+    kind, replied = decode_message(frame)
+    assert kind == "propose"
+    assert replied.digest() == block.digest()
+
+
+@async_test
+async def test_helper_ignores_unknown_digest_and_stranger():
+    from hotstuff_tpu.crypto import generate_keypair, sha512_digest
+
+    committee = consensus_committee(BASE + 10)
+    store = Store()
+    rx: asyncio.Queue = asyncio.Queue()
+    Helper.spawn(committee, store, rx)
+    stranger, _ = generate_keypair(seed=b"\x55" * 32)
+    await rx.put((sha512_digest(b"unknown"), stranger))  # unknown requestor
+    await rx.put((sha512_digest(b"unknown"), keys()[1][0]))  # unknown block
+    await asyncio.sleep(0.2)  # nothing to assert beyond "no crash/no send"

@@ -138,6 +138,23 @@ async def test_reliable_replays_unacked_on_reconnect():
 
 
 @async_test
+async def test_reliable_lucky_broadcast():
+    ports = [BASE_PORT + 30 + i for i in range(4)]
+    tasks = [asyncio.create_task(listener(p)) for p in ports]
+    await asyncio.sleep(0.05)
+    sender = ReliableSender()
+    handlers = sender.lucky_broadcast(
+        [("127.0.0.1", p) for p in ports], b"lucky", 2
+    )
+    assert len(handlers) == 2
+    acks = await asyncio.gather(*[asyncio.wait_for(h, 5) for h in handlers])
+    assert acks == [b"Ack"] * 2
+    for t in tasks:
+        t.cancel()
+    sender.shutdown()
+
+
+@async_test
 async def test_cancelled_handler_skips_replay():
     port = BASE_PORT + 22
     sender = ReliableSender()

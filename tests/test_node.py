@@ -1,0 +1,124 @@
+"""Node composition-root tests: config file roundtrips and a full 4-node
+in-process system driving real client transactions through mempool +
+consensus to the commit stream (the reference's `node deploy` testbed shape,
+``node/src/main.rs:103-163``)."""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.consensus import Authority as CAuth
+from hotstuff_tpu.consensus import Committee as CCommittee
+from hotstuff_tpu.consensus import Parameters as CParams
+from hotstuff_tpu.mempool import Authority as MAuth
+from hotstuff_tpu.mempool import Committee as MCommittee
+from hotstuff_tpu.mempool import Parameters as MParams
+from hotstuff_tpu.network.receiver import write_frame
+from hotstuff_tpu.node import Committee, Node, Parameters, Secret
+from hotstuff_tpu.node.config import ConfigError
+
+from .common import async_test
+
+BASE = 15000
+
+
+def _write_testbed(tmp_path, base_port, n=4):
+    secrets = [Secret.new() for _ in range(n)]
+    consensus = CCommittee(
+        authorities={
+            s.name: CAuth(stake=1, address=("127.0.0.1", base_port + i))
+            for i, s in enumerate(secrets)
+        }
+    )
+    mempool = MCommittee(
+        authorities={
+            s.name: MAuth(
+                stake=1,
+                transactions_address=("127.0.0.1", base_port + 100 + i),
+                mempool_address=("127.0.0.1", base_port + 200 + i),
+            )
+            for i, s in enumerate(secrets)
+        }
+    )
+    committee_file = str(tmp_path / "committee.json")
+    Committee(consensus, mempool).write(committee_file)
+    params_file = str(tmp_path / "parameters.json")
+    Parameters(
+        CParams(timeout_delay=2_000),
+        MParams(batch_size=200, max_batch_delay=50),
+    ).write(params_file)
+    key_files = []
+    for i, s in enumerate(secrets):
+        kf = str(tmp_path / f"node_{i}.json")
+        s.write(kf)
+        key_files.append(kf)
+    return committee_file, params_file, key_files
+
+
+def test_config_roundtrips(tmp_path):
+    committee_file, params_file, key_files = _write_testbed(tmp_path, BASE)
+    committee = Committee.read(committee_file)
+    assert committee.consensus.size() == 4
+    assert committee.mempool.quorum_threshold() == 3
+    params = Parameters.read(params_file)
+    assert params.consensus.timeout_delay == 2_000
+    assert params.mempool.batch_size == 200
+    secret = Secret.read(key_files[0])
+    assert secret.name in committee.consensus.authorities
+
+
+def test_config_errors(tmp_path):
+    bad = tmp_path / "bad.json"
+    bad.write_text("{}")
+    with pytest.raises(ConfigError):
+        Committee.read(str(bad))
+    with pytest.raises(ConfigError):
+        Secret.read(str(tmp_path / "missing.json"))
+
+
+@async_test
+async def test_four_nodes_commit_client_transactions(tmp_path):
+    """Boot 4 full nodes in-process, submit real transactions over TCP, and
+    assert a block carrying them commits on every node."""
+    committee_file, params_file, key_files = _write_testbed(tmp_path, BASE + 10)
+    nodes = []
+    for i, kf in enumerate(key_files):
+        node = await Node.new(
+            committee_file,
+            kf,
+            str(tmp_path / f"db_{i}"),
+            parameters_file=params_file,
+        )
+        nodes.append(node)
+
+    # Submit transactions to node 0's transactions port (size > batch_size
+    # forces an immediate seal).
+    _, writer = await asyncio.open_connection("127.0.0.1", BASE + 10 + 100)
+    tx = b"\x01" + (7).to_bytes(8, "big") + b"\xab" * 300
+    write_frame(writer, tx)
+    await writer.drain()
+
+    async def first_payload_commit(node):
+        while True:
+            block = await node.commit.get()
+            if block.payload:
+                return block
+
+    blocks = await asyncio.wait_for(
+        asyncio.gather(*[first_payload_commit(n) for n in nodes]), 30
+    )
+    digests = {b.digest() for b in blocks}
+    assert len(digests) == 1, "nodes committed different payload blocks"
+    assert len(blocks[0].payload) >= 1
+
+    # The committed payload digest resolves to the stored batch containing
+    # our transaction.
+    from hotstuff_tpu.mempool.messages import decode
+
+    batch_bytes = await nodes[0].store.read(blocks[0].payload[0].data)
+    kind, txs = decode(batch_bytes)
+    assert kind == "batch" and tx in txs
+
+    writer.close()
+    for n in nodes:
+        await n.shutdown()

@@ -1,0 +1,27 @@
+"""Resettable timer tests (reference ``consensus/src/tests/timer_tests.rs``)."""
+
+import asyncio
+import time
+
+from hotstuff_tpu.consensus.timer import Timer
+
+from .common import async_test
+
+
+@async_test
+async def test_timer_fires_after_duration():
+    t = Timer(50)
+    start = time.monotonic()
+    await t.wait()
+    assert time.monotonic() - start >= 0.045
+
+
+@async_test
+async def test_reset_postpones_firing():
+    t = Timer(80)
+    start = time.monotonic()
+    task = asyncio.create_task(t.wait())
+    await asyncio.sleep(0.05)
+    t.reset()  # pushes deadline to start+0.05+0.08
+    await task
+    assert time.monotonic() - start >= 0.12
