@@ -81,6 +81,40 @@ async def test_future_round_votes_bounded():
     node["sync"].shutdown()
 
 
+def test_rebuild_emits_qc_when_good_votes_meet_quorum():
+    """Unequal stakes: if the ejected signature was not load-bearing, the
+    surviving votes already form a quorum and rebuild must emit the QC
+    instead of stalling (regression for the stake-weighted case)."""
+    from hotstuff_tpu.consensus import Authority, Committee
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.consensus.messages import Vote
+
+    ks = keys(3)
+    # Stakes A=1, B=1, C=3 -> total 5, quorum = 2*5//3+1 = 4.
+    committee = Committee(
+        authorities={
+            ks[0][0]: Authority(stake=1, address=("127.0.0.1", 1)),
+            ks[1][0]: Authority(stake=1, address=("127.0.0.1", 2)),
+            ks[2][0]: Authority(stake=3, address=("127.0.0.1", 3)),
+        }
+    )
+    agg = Aggregator(committee)
+    block = chain(1)[0]
+    v_a = Vote.new_from_key(block.digest(), 1, ks[0][0], ks[0][1])
+    v_c = Vote.new_from_key(block.digest(), 1, ks[2][0], ks[2][1])
+    bad_b = Vote(block.digest(), 1, ks[1][0], Signature(b"\x03" * 64))
+
+    assert agg.add_vote(bad_b) is None  # stake 1
+    assert agg.add_vote(v_a) is None  # stake 2
+    qc = agg.add_vote(v_c)  # stake 5 >= 4 -> QC (contains the bad sig)
+    assert qc is not None
+    # Ejection keeps A (1) + C (3) = 4 >= quorum: rebuild must emit.
+    good = [(pk, sig) for pk, sig in qc.votes if pk != ks[1][0]]
+    rebuilt = agg.rebuild_votes(qc.round, qc.digest(), good, qc.hash)
+    assert rebuilt is not None
+    rebuilt.verify(committee)
+
+
 def test_aggregator_per_round_digest_bound():
     from hotstuff_tpu.consensus.aggregator import Aggregator
     from hotstuff_tpu.crypto import sha512_digest
