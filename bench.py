@@ -90,10 +90,40 @@ def main() -> None:
 
     msgs, pubs, sigs = make_batch(n_sigs)
     cpu_s = bench_cpu(msgs, pubs, sigs)
-    dev_s = bench_device(msgs, pubs, sigs)
+    cpu_us_per_sig = cpu_s / n_sigs * 1e6
+
+    # The TPU is reached through a tunnel that can go down; a hung device
+    # call must not wedge the benchmark forever. Run the device benchmark
+    # under a hard timeout and report the honest CPU-only fallback if the
+    # device is unreachable (15 min covers a full cold compile).
+    import os
+    from concurrent.futures import ThreadPoolExecutor
+
+    budget = float(os.environ.get("HOTSTUFF_BENCH_TIMEOUT", "900"))
+    with ThreadPoolExecutor(1) as ex:
+        fut = ex.submit(bench_device, msgs, pubs, sigs)
+        try:
+            dev_s = fut.result(timeout=budget)
+        except BaseException:
+            # Timeout, a fast-failing device error, or Ctrl+C while the
+            # device call hangs: always emit the one promised JSON line
+            # (honest CPU-only numbers) and exit immediately — a hung
+            # device call cannot be cancelled and would otherwise block
+            # the executor's shutdown join forever.
+            print(
+                json.dumps(
+                    {
+                        "metric": f"ed25519_qc_batch_verify_{n_sigs}sigs_TPU_UNREACHABLE_cpu_only",
+                        "value": round(cpu_us_per_sig, 3),
+                        "unit": "us/sig",
+                        "vs_baseline": 1.0,
+                    }
+                ),
+                flush=True,
+            )
+            os._exit(0)
 
     us_per_sig = dev_s / n_sigs * 1e6
-    cpu_us_per_sig = cpu_s / n_sigs * 1e6
     print(
         json.dumps(
             {
