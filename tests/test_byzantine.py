@@ -1,0 +1,133 @@
+"""Byzantine fault injection — beyond the reference's subtractive crash
+faults (SURVEY.md §4: "Byzantine behavior is covered only at the
+message-verification unit level" in the reference).
+
+A 4-node committee runs with one seat held by an active byzantine actor
+that sprays garbage frames, malformed messages, equivocating votes, and
+forged-leader proposals at the honest nodes. The three honest nodes
+(2f+1 = 3 of stake 4... quorum 3) must keep committing identical blocks.
+"""
+
+import asyncio
+import random
+
+from hotstuff_tpu.consensus import Consensus, Parameters
+from hotstuff_tpu.consensus.messages import (
+    Block,
+    QC,
+    Vote,
+    encode_propose,
+    encode_timeout,
+    encode_vote,
+)
+from hotstuff_tpu.consensus.messages import Timeout as TimeoutMsg
+from hotstuff_tpu.crypto import Signature, SignatureService, sha512_digest
+from hotstuff_tpu.network import SimpleSender
+from hotstuff_tpu.store import Store
+
+from .common import async_test, consensus_committee, keys
+
+BASE = 15800
+
+
+async def _byzantine_actor(committee, my_index: int, stop: asyncio.Event):
+    """The byzantine member: floods honest peers with adversarial traffic."""
+    my_pk, my_sk = keys()[my_index]
+    sender = SimpleSender()
+    rng = random.Random(666)
+    peers = [a for pk, a in committee.broadcast_addresses(my_pk)]
+    digest_a = sha512_digest(b"equivocation-a")
+    digest_b = sha512_digest(b"equivocation-b")
+    round_ = 1
+    while not stop.is_set():
+        # 1. Raw garbage frames.
+        sender.broadcast(peers, rng.randbytes(rng.randrange(1, 200)))
+        # 2. Equivocating votes: two conflicting votes for the same round.
+        va = Vote.new_from_key(digest_a, round_, my_pk, my_sk)
+        vb = Vote.new_from_key(digest_b, round_, my_pk, my_sk)
+        sender.broadcast(peers, encode_vote(va))
+        sender.broadcast(peers, encode_vote(vb))
+        # 3. A forged proposal claiming leadership with a garbage QC.
+        fake_qc = QC(hash=digest_a, round=round_, votes=[])
+        fake = Block.new_from_key(fake_qc, None, my_pk, round_ + 1, [], my_sk)
+        sender.broadcast(peers, encode_propose(fake))
+        # 4. Timeouts with bogus signatures.
+        t = TimeoutMsg(QC.genesis(), round_, my_pk, Signature(b"\x0b" * 64))
+        sender.broadcast(peers, encode_timeout(t))
+        round_ += 1
+        await asyncio.sleep(0.02)
+    sender.shutdown()
+
+
+async def _honest_committee(base_port: int, byzantine_index: int, params: Parameters):
+    committee = consensus_committee(base_port)
+    engines, commits, sinks = [], [], []
+    for i, (pk, sk) in enumerate(keys()):
+        if i == byzantine_index:
+            continue
+        rx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_commit: asyncio.Queue = asyncio.Queue()
+
+        async def drain(q=tx_mempool):
+            while True:
+                await q.get()
+
+        sinks.append(asyncio.create_task(drain()))
+        engines.append(
+            await Consensus.spawn(
+                pk,
+                committee,
+                params,
+                SignatureService(sk),
+                Store(),
+                rx_mempool,
+                tx_mempool,
+                tx_commit,
+            )
+        )
+        commits.append(tx_commit)
+    return committee, engines, commits, sinks
+
+
+async def _run_byzantine_case(base_port: int, params: Parameters):
+    byzantine_index = 3
+    committee, engines, commits, sinks = await _honest_committee(
+        base_port, byzantine_index, params
+    )
+    stop = asyncio.Event()
+    attacker = asyncio.create_task(_byzantine_actor(committee, byzantine_index, stop))
+
+    # Under active attack, all honest nodes must agree on a prefix of
+    # committed blocks.
+    seen = []
+    for _ in range(4):
+        blocks = await asyncio.wait_for(
+            asyncio.gather(*[q.get() for q in commits]), 60
+        )
+        assert len({b.digest() for b in blocks}) == 1, "honest nodes diverged"
+        seen.append(blocks[0])
+    rounds = [b.round for b in seen]
+    assert rounds == sorted(rounds), "commit order regressed"
+
+    stop.set()
+    await attacker
+    for e in engines:
+        await e.shutdown()
+    for s in sinks:
+        s.cancel()
+
+
+@async_test
+async def test_honest_nodes_commit_under_byzantine_attack():
+    await _run_byzantine_case(BASE, Parameters(timeout_delay=3_000))
+
+
+@async_test
+async def test_honest_nodes_commit_under_attack_with_batched_votes():
+    """The batched-vote path faces the same attack: equivocating votes and
+    garbage signatures from the byzantine seat must not stall it."""
+    await _run_byzantine_case(
+        BASE + 20,
+        Parameters(timeout_delay=3_000, batch_vote_verification=True),
+    )
