@@ -103,7 +103,7 @@ def main() -> None:
     budget = float(os.environ.get("HOTSTUFF_BENCH_TIMEOUT", "900"))
     with ThreadPoolExecutor(1) as ex:
         fut = ex.submit(bench_device, msgs, pubs, sigs)
-        def fallback(reason_suffix: str) -> None:
+        def fallback(reason_suffix: str, code: int = 0) -> None:
             # Always emit the one promised JSON line (honest CPU-only
             # numbers, explicitly labeled) and exit immediately — a hung
             # device call cannot be cancelled and would otherwise block
@@ -119,7 +119,7 @@ def main() -> None:
                 ),
                 flush=True,
             )
-            os._exit(0)
+            os._exit(code)
 
         try:
             dev_s = fut.result(timeout=budget)
@@ -130,11 +130,12 @@ def main() -> None:
         except Exception:
             # A fast-failing device error or a verification-correctness
             # regression is NOT an outage: keep the one-line contract but
-            # label it distinctly and preserve the diagnostic.
+            # label it distinctly, preserve the diagnostic, and exit
+            # nonzero so exit-status checks see the failure.
             import traceback
 
             traceback.print_exc(file=sys.stderr)
-            fallback("DEVICE_ERROR")
+            fallback("DEVICE_ERROR", code=1)
 
     us_per_sig = dev_s / n_sigs * 1e6
     print(

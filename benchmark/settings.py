@@ -17,7 +17,9 @@ class Settings:
         testbed: str,
         key_name: str,
         key_path: str,
-        base_port: int,
+        consensus_port: int,
+        mempool_port: int,
+        front_port: int,
         repo_name: str,
         repo_url: str,
         branch: str,
@@ -27,24 +29,14 @@ class Settings:
         self.testbed = testbed
         self.key_name = key_name
         self.key_path = key_path
-        self.base_port = base_port
+        self.consensus_port = consensus_port
+        self.mempool_port = mempool_port
+        self.front_port = front_port
         self.repo_name = repo_name
         self.repo_url = repo_url
         self.branch = branch
         self.instance_type = instance_type
         self.aws_regions = aws_regions
-
-    @property
-    def consensus_port(self) -> int:
-        return self.base_port
-
-    @property
-    def mempool_port(self) -> int:
-        return self.base_port + 1_000
-
-    @property
-    def front_port(self) -> int:
-        return self.base_port + 2_000
 
     @classmethod
     def load(cls, filename: str = "settings.json") -> "Settings":
@@ -55,7 +47,9 @@ class Settings:
                 testbed=data["testbed"],
                 key_name=data["key"]["name"],
                 key_path=data["key"]["path"],
-                base_port=int(data["ports"]["consensus"]),
+                consensus_port=int(data["ports"]["consensus"]),
+                mempool_port=int(data["ports"]["mempool"]),
+                front_port=int(data["ports"]["front"]),
                 repo_name=data["repo"]["name"],
                 repo_url=data["repo"]["url"],
                 branch=data["repo"]["branch"],
