@@ -126,7 +126,7 @@ def main() -> None:
         except FutTimeout:
             fallback("TPU_UNREACHABLE")
         except KeyboardInterrupt:
-            fallback("INTERRUPTED")
+            fallback("INTERRUPTED", code=130)
         except Exception:
             # A fast-failing device error or a verification-correctness
             # regression is NOT an outage: keep the one-line contract but

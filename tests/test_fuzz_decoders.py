@@ -1,0 +1,81 @@
+"""Fuzz the wire decoders: byzantine peers control every byte on the wire,
+so malformed input must produce exactly SerdeError/MalformedMessage (the
+errors the receiver handlers catch) — never any other exception type."""
+
+import random
+
+import pytest
+
+from hotstuff_tpu.consensus import errors as consensus_errors
+from hotstuff_tpu.consensus.messages import Block, decode_message, encode_propose
+from hotstuff_tpu.mempool import messages as mempool_messages
+from hotstuff_tpu.utils.serde import SerdeError
+
+from .common import chain
+
+ALLOWED = (SerdeError, consensus_errors.MalformedMessage)
+
+rng = random.Random(31337)
+
+
+def test_random_bytes_consensus_decoder():
+    for length in [0, 1, 5, 33, 100, 500]:
+        for _ in range(300):
+            buf = rng.randbytes(length)
+            try:
+                decode_message(buf)
+            except ALLOWED:
+                pass  # the only acceptable failure mode
+
+
+def test_random_bytes_mempool_decoder():
+    for length in [0, 1, 5, 33, 100, 500]:
+        for _ in range(300):
+            buf = rng.randbytes(length)
+            try:
+                mempool_messages.decode(buf)
+            except ALLOWED:
+                pass
+
+
+def test_truncations_and_bitflips_of_valid_messages():
+    """Every truncation and single-byte corruption of a real message must
+    decode, or fail with exactly the allowed errors."""
+    block = chain(3)[2]
+    wire = encode_propose(block)
+    for cut in range(0, len(wire), 7):
+        try:
+            decode_message(wire[:cut])
+        except ALLOWED:
+            pass
+    for pos in range(0, len(wire), 11):
+        corrupted = bytearray(wire)
+        corrupted[pos] ^= 0xFF
+        try:
+            decode_message(bytes(corrupted))
+        except ALLOWED:
+            pass
+
+
+def test_block_deserialize_fuzz():
+    data = chain(2)[1].serialize()
+    for _ in range(500):
+        buf = bytearray(data)
+        for _ in range(rng.randrange(1, 6)):
+            buf[rng.randrange(len(buf))] = rng.randrange(256)
+        try:
+            Block.deserialize(bytes(buf))
+        except ALLOWED:
+            pass
+
+
+def test_huge_length_prefixes_bounded():
+    """Length/count prefixes near MAX_LEN must fail fast, not allocate."""
+    from hotstuff_tpu.utils.serde import MAX_LEN, Encoder
+
+    evil = Encoder().u8(0).u32(MAX_LEN + 1).finish()  # batch with 64M+1 txs
+    with pytest.raises(SerdeError):
+        mempool_messages.decode(evil)
+    evil2 = Encoder().u8(0).u32(1).u32(MAX_LEN + 1).finish()  # giant tx
+    with pytest.raises(SerdeError):
+        mempool_messages.decode(evil2)
