@@ -33,9 +33,25 @@ _EXECUTOR: ThreadPoolExecutor | None = None
 def _executor() -> ThreadPoolExecutor:
     global _EXECUTOR
     if _EXECUTOR is None:
-        # 2 workers: one verification in flight while the next batch's host
-        # prep runs — matches the device pipeline depth that saturates it.
-        _EXECUTOR = ThreadPoolExecutor(max_workers=2, thread_name_prefix="crypto")
+        import os
+
+        # Default 2 workers: one verification in flight while the next
+        # batch's host prep runs — the device pipeline depth that saturates
+        # it. Raise HOTSTUFF_CRYPTO_WORKERS when super-batching
+        # (crypto/batching.py) should fuse more concurrent requests — e.g.
+        # many in-process validators sharing one device.
+        raw = os.environ.get("HOTSTUFF_CRYPTO_WORKERS", "2")
+        try:
+            workers = int(raw)
+            if workers < 1:
+                raise ValueError(raw)
+        except ValueError:
+            raise ValueError(
+                f"HOTSTUFF_CRYPTO_WORKERS must be a positive integer, got {raw!r}"
+            ) from None
+        _EXECUTOR = ThreadPoolExecutor(
+            max_workers=workers, thread_name_prefix="crypto"
+        )
     return _EXECUTOR
 
 

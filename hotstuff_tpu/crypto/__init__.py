@@ -8,8 +8,9 @@ protocol digests are SHA-512 truncated to 32 bytes and signatures sign the
 ``consensus/src/messages.rs:79-90``).
 
 Batch verification is a pluggable backend: ``cpu`` (OpenSSL per-signature
-loop) or ``tpu`` (JAX random-linear-combination MSM on device) — selected via
-``set_backend()`` or the ``HOTSTUFF_CRYPTO_BACKEND`` env var. This is the
+loop) or ``tpu`` (JAX random-linear-combination MSM on device), optionally
+wrapped for multi-round super-batching (``cpu-batched``/``tpu-batched``) —
+selected via ``set_backend()`` or the ``HOTSTUFF_CRYPTO_BACKEND`` env var. This is the
 north-star offload site: QC verification calls ``Signature.verify_batch`` with
 the 2f+1 vote signatures of a quorum certificate.
 """
@@ -348,21 +349,36 @@ def get_backend():
 
 
 def set_backend(name_or_backend) -> None:
-    """Select the batch-verify backend: "cpu", "tpu", or a backend object."""
+    """Select the batch-verify backend: "cpu", "tpu", their super-batching
+    variants "cpu-batched"/"tpu-batched" (fuse concurrent verification
+    requests into one call, see ``crypto/batching.py``), or a backend
+    object."""
     global _BACKEND
     if not isinstance(name_or_backend, str):
         _BACKEND = name_or_backend
         return
+    # Validate fully and construct into a local before touching the global:
+    # a failed set_backend must leave the active backend unchanged.
     name = name_or_backend
-    if name == "cpu":
-        _BACKEND = CpuBackend()
-    elif name == "tpu":
+    base, sep, variant = name.partition("-")
+    if base not in ("cpu", "tpu"):
+        raise ValueError(f"unknown crypto backend {name!r}")
+    if sep and variant != "batched":
+        raise ValueError(f"unknown crypto backend variant {name!r}")
+    if base == "cpu":
+        backend = CpuBackend()
+    else:
         # Imported lazily: pulls in jax.
         from .tpu_backend import TpuBackend
 
-        _BACKEND = TpuBackend()
-    else:
-        raise ValueError(f"unknown crypto backend {name!r}")
+        backend = TpuBackend()
+    if variant == "batched":
+        # Fuse concurrent verification requests into one device call
+        # (multi-round super-batching, see crypto/batching.py).
+        from .batching import BatchingBackend
+
+        backend = BatchingBackend(backend)
+    _BACKEND = backend
 
 
 class SignatureService:

@@ -1,0 +1,123 @@
+"""Super-batching backend: concurrent verification requests fuse into one
+inner call; byzantine requests are isolated; the Signature API routes
+through it via the "-batched" backend variants."""
+
+import threading
+
+import pytest
+
+from hotstuff_tpu.crypto import (
+    CpuBackend,
+    CryptoError,
+    Signature,
+    get_backend,
+    set_backend,
+    sha512_digest,
+)
+from hotstuff_tpu.crypto.batching import BatchingBackend
+
+from .common import keys
+
+
+@pytest.fixture(autouse=True)
+def restore_backend():
+    yield
+    set_backend("cpu")
+
+
+class CountingBackend(CpuBackend):
+    def __init__(self):
+        super().__init__()
+        self.calls = []
+
+    def verify_batch(self, msgs, pubs, sigs):
+        self.calls.append(len(msgs))
+        super().verify_batch(msgs, pubs, sigs)
+
+
+def make_request(n=3, tag=b"m"):
+    d = sha512_digest(tag)
+    msgs, pubs, sigs = [], [], []
+    for pk, sk in keys(4)[:n]:
+        msgs.append(d.data)
+        pubs.append(pk.data)
+        sigs.append(Signature.new(d, sk).data)
+    return msgs, pubs, sigs
+
+
+def _run_threads(backend, requests):
+    errors = [None] * len(requests)
+
+    def worker(i, req):
+        try:
+            backend.verify_batch(*req)
+        except CryptoError as e:
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=worker, args=(i, r)) for i, r in enumerate(requests)
+    ]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join()
+    return errors
+
+
+def test_concurrent_requests_fuse_into_one_call():
+    inner = CountingBackend()
+    backend = BatchingBackend(inner, window_ms=50)
+    requests = [make_request(tag=b"r%d" % i) for i in range(6)]
+    errors = _run_threads(backend, requests)
+    assert errors == [None] * 6
+    assert inner.calls == [18], f"expected one fused call, got {inner.calls}"
+    assert backend.fused_requests == 6 and backend.inner_calls == 1
+
+
+def test_byzantine_request_isolated():
+    inner = CountingBackend()
+    backend = BatchingBackend(inner, window_ms=50)
+    good = [make_request(tag=b"g%d" % i) for i in range(3)]
+    bad_msgs, bad_pubs, bad_sigs = make_request(tag=b"bad")
+    bad_sigs[1] = bytes(64)
+    errors = _run_threads(backend, good + [(bad_msgs, bad_pubs, bad_sigs)])
+    assert errors[:3] == [None] * 3, "good requests poisoned by the bad one"
+    assert isinstance(errors[3], CryptoError)
+    # One fused attempt + one isolation pass per request.
+    assert inner.calls[0] == 12 and len(inner.calls) == 5
+
+
+def test_sequential_requests_still_work():
+    backend = BatchingBackend(CountingBackend(), window_ms=1)
+    for i in range(3):
+        backend.verify_batch(*make_request(tag=b"s%d" % i))
+    with pytest.raises(CryptoError):
+        m, p, s = make_request(tag=b"x")
+        backend.verify_batch(m, p, [bytes(64)] * len(s))
+
+
+def test_backend_variant_names():
+    set_backend("cpu-batched")
+    backend = get_backend()
+    assert isinstance(backend, BatchingBackend)
+    assert backend.name == "cpu+superbatch"
+    # The public Signature API routes through it.
+    d = sha512_digest(b"qc")
+    votes = [(pk, Signature.new(d, sk)) for pk, sk in keys(4)]
+    Signature.verify_batch(d, votes)
+    with pytest.raises(ValueError):
+        set_backend("cpu-bogus")
+    # A failed set_backend must leave the active backend unchanged.
+    assert get_backend() is backend
+    with pytest.raises(ValueError):
+        set_backend("tpu-")  # trailing dash = malformed, not bare tpu
+    assert get_backend() is backend
+
+
+def test_enable_superbatching_idempotent():
+    from hotstuff_tpu.crypto.batching import enable_superbatching
+
+    set_backend("cpu")
+    b1 = enable_superbatching()
+    b2 = enable_superbatching()
+    assert b1 is b2
