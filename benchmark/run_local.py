@@ -25,7 +25,11 @@ def main() -> None:
     p.add_argument("--max-batch-delay", type=int, default=10, help="ms")
     p.add_argument("--base-port", type=int, default=9000)
     p.add_argument("--work-dir", default=".bench")
-    p.add_argument("--crypto-backend", default="cpu", choices=["cpu", "tpu"])
+    p.add_argument(
+        "--crypto-backend",
+        default="cpu",
+        choices=["cpu", "tpu", "cpu-batched", "tpu-batched"],
+    )
     args = p.parse_args()
 
     bench = LocalBench(
