@@ -13,15 +13,27 @@ import struct
 from hotstuff_tpu.crypto import PublicKey, SecretKey, generate_keypair
 
 
-def async_test(fn):
+def async_test(fn=None, *, timeout: float = 60):
     """Run an ``async def`` test on a fresh event loop (no pytest-asyncio in
-    this environment)."""
+    this environment). Use ``@async_test(timeout=N)`` for long scenarios —
+    inner wait_for budgets must fit under this outer cap."""
 
-    @functools.wraps(fn)
-    def wrapper(*args, **kwargs):
-        return asyncio.run(asyncio.wait_for(fn(*args, **kwargs), timeout=60))
+    def decorate(f):
+        @functools.wraps(f)
+        def wrapper(*args, **kwargs):
+            return asyncio.run(asyncio.wait_for(f(*args, **kwargs), timeout=timeout))
 
-    return wrapper
+        return wrapper
+
+    return decorate(fn) if fn is not None else decorate
+
+
+async def next_payload_commit(node):
+    """Drain a node's commit stream until a block carrying payload arrives."""
+    while True:
+        block = await node.commit.get()
+        if block.payload:
+            return block
 
 
 def keys(n: int = 4) -> list[tuple[PublicKey, SecretKey]]:

@@ -98,14 +98,10 @@ async def test_four_nodes_commit_client_transactions(tmp_path):
     write_frame(writer, tx)
     await writer.drain()
 
-    async def first_payload_commit(node):
-        while True:
-            block = await node.commit.get()
-            if block.payload:
-                return block
+    from .common import next_payload_commit
 
     blocks = await asyncio.wait_for(
-        asyncio.gather(*[first_payload_commit(n) for n in nodes]), 30
+        asyncio.gather(*[next_payload_commit(n) for n in nodes]), 30
     )
     digests = {b.digest() for b in blocks}
     assert len(digests) == 1, "nodes committed different payload blocks"
