@@ -171,11 +171,6 @@ def square(a: jnp.ndarray) -> jnp.ndarray:
     return mul(a, a)
 
 
-def mul_small(a: jnp.ndarray, k: int) -> jnp.ndarray:
-    """Multiply by a small constant (k < 2^17)."""
-    return carry(a * jnp.int32(k))
-
-
 def pow_const(a: jnp.ndarray, e: int) -> jnp.ndarray:
     """a^e for a fixed public exponent (square-and-multiply as a lax.scan
     over the exponent bits LSB-first, keeping the compiled graph one
