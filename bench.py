@@ -100,7 +100,9 @@ def main() -> None:
     from concurrent.futures import ThreadPoolExecutor
     from concurrent.futures import TimeoutError as FutTimeout
 
-    budget = float(os.environ.get("HOTSTUFF_BENCH_TIMEOUT", "900"))
+    # Covers a full cold compile (~400 s worst observed) with margin, while
+    # staying comfortably inside typical harness timeouts.
+    budget = float(os.environ.get("HOTSTUFF_BENCH_TIMEOUT", "600"))
     with ThreadPoolExecutor(1) as ex:
         fut = ex.submit(bench_device, msgs, pubs, sigs)
         def fallback(reason_suffix: str, code: int = 0) -> None:
