@@ -62,27 +62,57 @@ class Aggregator:
         self.committee = committee
         self.votes_aggregators: dict[Round, dict] = {}
         self.timeouts_aggregators: dict[Round, TCMaker] = {}
-
-    # An honest round has exactly one proposal digest; 2N distinct digests
-    # per round is a generous bound that caps the memory an attacker can
-    # allocate per round (tightens the reference's open DoS caveat,
-    # ``aggregator.rs:29-30`` issue #7).
-    MAX_DIGESTS_PER_ROUND_FACTOR = 2
+        # Per-round author -> digest-bucket binding: each authority occupies
+        # at most ONE digest bucket per round, so the number of buckets is
+        # bounded by committee size and no set of byzantine members can
+        # displace honest votes by fabricating digests (tightens the
+        # reference's open DoS caveat, ``aggregator.rs:29-30`` issue #7).
+        self.author_bucket: dict[Round, dict] = {}
 
     def add_vote(self, vote: Vote) -> QC | None:
         per_round = self.votes_aggregators.setdefault(vote.round, {})
+        buckets = self.author_bucket.setdefault(vote.round, {})
         key = vote.digest()
-        if (
-            key not in per_round
-            and len(per_round)
-            >= self.MAX_DIGESTS_PER_ROUND_FACTOR * self.committee.size()
-        ):
-            log.warning(
-                "dropping vote for round %d: per-round digest bound reached",
-                vote.round,
-            )
+        prev = buckets.get(vote.author)
+        if prev is not None and prev != key:
+            # The author already voted for a different digest this round:
+            # equivocation (verified path) or a possible spoof (batched
+            # path — the core re-seats after individual verification).
+            raise AuthorityReuse(str(vote.author))
+        qc = per_round.setdefault(key, QCMaker()).append(vote, self.committee)
+        buckets[vote.author] = key
+        return qc
+
+    def reseat_vote(self, vote: Vote) -> QC | None:
+        """Place an INDIVIDUALLY VERIFIED vote whose author's slot was taken.
+
+        Same-bucket conflict: the stored (possibly spoofed) signature is
+        swapped for the genuine one. Cross-bucket conflict: the author's old
+        entry — spoofed, or genuine equivocation by a byzantine author;
+        either way not worth keeping over a verified vote — is evicted and
+        the vote is added normally (it may complete a quorum, so the QC
+        return value must be handled like ``add_vote``'s)."""
+        buckets = self.author_bucket.get(vote.round, {})
+        prev = buckets.get(vote.author)
+        key = vote.digest()
+        if prev == key:
+            self.replace_vote(vote)
             return None
-        return per_round.setdefault(key, QCMaker()).append(vote, self.committee)
+        if prev is not None:
+            makers = self.votes_aggregators.get(vote.round, {})
+            maker = makers.get(prev)
+            if maker is not None and vote.author in maker.used:
+                maker.votes = [
+                    (pk, sig) for pk, sig in maker.votes if pk != vote.author
+                ]
+                maker.used.discard(vote.author)
+                maker.weight = max(
+                    0, maker.weight - self.committee.stake(vote.author)
+                )
+                if not maker.used:
+                    del makers[prev]
+            del buckets[vote.author]
+        return self.add_vote(vote)
 
     def stored_signature(self, round_: Round, digest, author):
         """The signature currently held for (round, digest, author), if any."""
@@ -113,6 +143,12 @@ class Aggregator:
         maker.used = {pk for pk, _ in good_votes}
         maker.weight = sum(self.committee.stake(pk) for pk, _ in good_votes)
         self.votes_aggregators.setdefault(round_, {})[digest] = maker
+        buckets = self.author_bucket.setdefault(round_, {})
+        for pk in [a for a, d in buckets.items() if d == digest]:
+            if pk not in maker.used:
+                del buckets[pk]  # ejected: free to vote again
+        for pk in maker.used:
+            buckets[pk] = digest
         if maker.weight >= self.committee.quorum_threshold():
             maker.weight = 0  # QC emitted exactly once
             return QC(hash=hash_, round=round_, votes=list(maker.votes))
@@ -136,4 +172,7 @@ class Aggregator:
         }
         self.timeouts_aggregators = {
             k: v for k, v in self.timeouts_aggregators.items() if k >= round_
+        }
+        self.author_bucket = {
+            k: v for k, v in self.author_bucket.items() if k >= round_
         }

@@ -27,10 +27,11 @@ import logging
 
 from hotstuff_tpu.crypto import PublicKey, SignatureService
 from hotstuff_tpu.network import SimpleSender
-from hotstuff_tpu.store import Store
-from hotstuff_tpu.utils.serde import Decoder, Encoder
+from hotstuff_tpu.store import Store, StoreError
+from hotstuff_tpu.utils.serde import Decoder, Encoder, SerdeError
+from hotstuff_tpu.utils.tasks import log_task_death
 
-from hotstuff_tpu.crypto import CryptoError
+from hotstuff_tpu.crypto import BackendUnavailable, CryptoError
 
 from .aggregator import Aggregator
 from .config import Committee, Round
@@ -101,11 +102,21 @@ class Core:
         # round -> set of known-byzantine vote keys (author||sig||hash);
         # GC'd with the aggregator on round advance.
         self._bad_sigs: dict[Round, set[bytes]] = {}
+        # round -> authors whose seat already holds an INDIVIDUALLY
+        # VERIFIED vote: further conflicting votes from them (replays, or
+        # genuine equivocation by a proven-byzantine author) drop without
+        # paying another signature verification — closes the replay-DoS on
+        # the reseat path. GC'd with _bad_sigs.
+        self._verified_seats: dict[Round, set] = {}
+        # Strong references to in-flight qc_retry timer tasks.
+        self._retry_tasks: set[asyncio.Task] = set()
 
     @classmethod
     def spawn(cls, *args, **kwargs) -> asyncio.Task:
         self = cls(*args, **kwargs)
-        return asyncio.create_task(self.run(), name="consensus_core")
+        task = asyncio.create_task(self.run(), name="consensus_core")
+        task.add_done_callback(log_task_death)
+        return task
 
     # -- persistence of voting state (fixes reference issue #15) ------------
 
@@ -217,10 +228,13 @@ class Core:
             await verify_off_loop(vote.verify, self.committee)
             qc = self.aggregator.add_vote(vote)
         if qc is not None:
-            log.debug("Assembled %r", qc)
-            await self.process_qc(qc)
-            if self.name == self.leader_elector.get_leader(self.round):
-                await self.generate_proposal(None)
+            await self._complete_qc(qc)
+
+    async def _complete_qc(self, qc: QC) -> None:
+        log.debug("Assembled %r", qc)
+        await self.process_qc(qc)
+        if self.name == self.leader_elector.get_leader(self.round):
+            await self.generate_proposal(None)
 
     async def _handle_vote_batched(self, vote: Vote) -> QC | None:
         """Committee-scale path: only cheap checks per vote; the 2f+1
@@ -233,29 +247,87 @@ class Core:
         try:
             qc = self.aggregator.add_vote(vote)
         except ConsensusError:
-            # The author's slot is taken — possibly by a spoofed vote that
-            # would otherwise displace the honest one. Identical resends
-            # drop free; a DIFFERENT signature is verified individually and
-            # swapped in if genuine, preserving liveness under spoofing.
+            # The author's slot is taken — same bucket or (since the
+            # one-bucket-per-author bound) a different digest's bucket —
+            # possibly by a spoofed vote that would otherwise displace the
+            # honest one. Identical resends drop free; a DIFFERENT
+            # signature is verified individually and re-seated if genuine,
+            # preserving liveness under spoofing.
             stored = self.aggregator.stored_signature(
                 vote.round, vote.digest(), vote.author
             )
             if stored == vote.signature:
                 return None
+            if vote.author in self._verified_seats.get(vote.round, set()):
+                return None  # seat already verified: replay/equivocation
             try:
                 await verify_off_loop(vote.verify, self.committee)
             except ConsensusError:
                 self._record_bad(vote.round, self._vote_key(vote))
                 return None
-            self.aggregator.replace_vote(vote)
-            return None
+            self._verified_seats.setdefault(vote.round, set()).add(vote.author)
+            qc = self.aggregator.reseat_vote(vote)
         if qc is None:
             return None
         try:
             await verify_off_loop(qc.verify, self.committee)
             return qc
+        except BackendUnavailable as e:
+            # The assembled QC was NOT judged (device/tunnel failure). Its
+            # weight is already consumed in the aggregator, so retry the
+            # verification later instead of losing the QC until view change.
+            log.error("backend unavailable verifying %r (will retry): %s", qc, e)
+            self._schedule_qc_retry(qc, attempt=1)
+            return None
         except ConsensusError:
-            return await self._eject_invalid_votes(qc)
+            try:
+                return await self._eject_invalid_votes(qc)
+            except BackendUnavailable as e:
+                # Backend died mid-ejection: the QC is still unjudged.
+                log.error("backend died during ejection (will retry): %s", e)
+                self._schedule_qc_retry(qc, attempt=1)
+                return None
+
+    QC_RETRY_MAX = 6
+    QC_RETRY_BASE_S = 0.25
+
+    def _schedule_qc_retry(self, qc: QC, attempt: int) -> None:
+        """Bounded backoff retry of an unjudged QC; if the backend stays
+        down past the last attempt, the round's timeout/view-change is the
+        fallback recovery (as for any liveness failure)."""
+        if attempt > self.QC_RETRY_MAX:
+            log.error("giving up QC verification retries for %r", qc)
+            return
+
+        async def later() -> None:
+            await asyncio.sleep(self.QC_RETRY_BASE_S * attempt)
+            await self.rx_message.put(("qc_retry", (qc, attempt)))
+
+        task = asyncio.create_task(later(), name="qc_retry")
+        # Strong reference: a sleeping fire-and-forget task may otherwise
+        # be garbage-collected before it runs.
+        self._retry_tasks.add(task)
+        task.add_done_callback(self._retry_tasks.discard)
+        task.add_done_callback(log_task_death)
+
+    async def _handle_qc_retry(self, payload) -> None:
+        qc, attempt = payload
+        if qc.round < self.round:
+            return  # the protocol moved on
+        try:
+            await verify_off_loop(qc.verify, self.committee)
+        except BackendUnavailable:
+            self._schedule_qc_retry(qc, attempt + 1)
+            return
+        except ConsensusError:
+            try:
+                qc = await self._eject_invalid_votes(qc)
+            except BackendUnavailable:
+                self._schedule_qc_retry(qc, attempt + 1)
+                return
+            if qc is None:
+                return
+        await self._complete_qc(qc)
 
     async def _eject_invalid_votes(self, qc: QC) -> QC | None:
         """A batch-verified QC failed: identify the byzantine signatures
@@ -270,6 +342,8 @@ class Core:
                 try:
                     sig.verify(digest, pk)
                     good.append((pk, sig))
+                except BackendUnavailable:
+                    raise  # NOT judged: never classify as byzantine
                 except CryptoError:
                     bad.append((pk, sig))
             return good, bad
@@ -312,6 +386,9 @@ class Core:
         log.debug("Moved to round %d", self.round)
         self.aggregator.cleanup(self.round)
         self._bad_sigs = {r: s for r, s in self._bad_sigs.items() if r >= self.round}
+        self._verified_seats = {
+            r: s for r, s in self._verified_seats.items() if r >= self.round
+        }
 
     async def generate_proposal(self, tc: TC | None) -> None:
         await self.tx_proposer.put(ProposerMake(self.round, self.high_qc, tc))
@@ -406,6 +483,7 @@ class Core:
                     "vote": self.handle_vote,
                     "timeout": self.handle_timeout,
                     "tc": self.handle_tc,
+                    "qc_retry": self._handle_qc_retry,  # internal loopback
                 }
                 handler = handlers.get(kind)
                 if handler is None:
@@ -422,9 +500,20 @@ class Core:
                 await self._guarded(self.local_timeout_round())
 
     async def _guarded(self, coro) -> None:
-        """Protocol errors (byzantine input) are logged, never fatal
-        (reference ``core.rs:434-440``)."""
+        """Protocol errors (byzantine input) are logged, never fatal —
+        as are store/serialization errors from locally-stored data, which
+        the reference run loop likewise logs and survives (reference
+        ``core.rs:434-440``: SerializationError/StoreError arms).
+        Invariant violations (AssertionError) stay FATAL — safer to halt
+        than run on corrupt state — but die loudly via the task
+        done-callback, never silently."""
         try:
             await coro
         except ConsensusError as e:
             log.warning("%s: %s", type(e).__name__, e)
+        except BackendUnavailable as e:
+            # Transient infrastructure failure: the message was not judged;
+            # peers will resend. Nothing is cached as byzantine.
+            log.error("crypto backend unavailable: %s", e)
+        except (SerdeError, StoreError) as e:
+            log.error("consensus handler error: %s: %s", type(e).__name__, e)

@@ -9,6 +9,7 @@ import logging
 
 from hotstuff_tpu.network import SimpleSender
 from hotstuff_tpu.store import Store
+from hotstuff_tpu.utils.tasks import log_task_death
 
 from .config import Committee
 from .messages import Block, encode_propose
@@ -26,13 +27,26 @@ class Helper:
         async def run():
             while True:
                 digest, origin = await rx_request.get()
-                address = committee.address(origin)
-                if address is None:
-                    log.warning("received sync request from unknown node %s", origin)
-                    continue
-                data = await store.read(digest.data)
-                if data is not None:
-                    block = Block.deserialize(data)
-                    network.send(address, encode_propose(block))
+                try:
+                    address = committee.address(origin)
+                    if address is None:
+                        log.warning(
+                            "received sync request from unknown node %s", origin
+                        )
+                        continue
+                    data = await store.read(digest.data)
+                    if data is not None:
+                        block = Block.deserialize(data)
+                        network.send(address, encode_propose(block))
+                except asyncio.CancelledError:
+                    raise
+                except Exception as e:
+                    # One corrupt stored block (or store error) must not
+                    # permanently kill the helper for all future requests.
+                    log.error(
+                        "failed to serve sync request for %s: %s", digest, e
+                    )
 
-        return asyncio.create_task(run(), name="consensus_helper")
+        task = asyncio.create_task(run(), name="consensus_helper")
+        task.add_done_callback(log_task_death)
+        return task

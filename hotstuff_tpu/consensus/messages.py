@@ -18,6 +18,7 @@ import struct
 from dataclasses import dataclass
 
 from hotstuff_tpu.crypto import (
+    BackendUnavailable,
     CryptoError,
     Digest,
     PublicKey,
@@ -76,6 +77,8 @@ class QC:
             raise errors.QCRequiresQuorum("QC requires a quorum")
         try:
             Signature.verify_batch(self.digest(), self.votes)
+        except BackendUnavailable:
+            raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
 
@@ -136,6 +139,8 @@ class TC:
                     for author, sig, hqc_round in self.votes
                 ]
             )
+        except BackendUnavailable:
+            raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
 
@@ -213,6 +218,8 @@ class Block:
             raise errors.UnknownAuthority(str(self.author))
         try:
             self.signature.verify(self.digest(), self.author)
+        except BackendUnavailable:
+            raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
         if self.qc != QC.genesis():
@@ -293,6 +300,8 @@ class Vote:
             raise errors.UnknownAuthority(str(self.author))
         try:
             self.signature.verify(self.digest(), self.author)
+        except BackendUnavailable:
+            raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
 
@@ -346,6 +355,8 @@ class Timeout:
             raise errors.UnknownAuthority(str(self.author))
         try:
             self.signature.verify(self.digest(), self.author)
+        except BackendUnavailable:
+            raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
         if self.high_qc != QC.genesis():

@@ -36,6 +36,14 @@ class CryptoError(Exception):
     """Signature or encoding verification failure."""
 
 
+class BackendUnavailable(CryptoError):
+    """The verification BACKEND failed (device/tunnel death, JAX runtime
+    error) — the signatures were NOT judged. Callers must treat this as
+    transient infrastructure failure, never as a byzantine signature:
+    recording it in bad-signature caches would blacklist honest validators
+    for the round."""
+
+
 class Digest:
     """32-byte hash value; base64 display (reference ``crypto/src/lib.rs:20-62``)."""
 
@@ -398,6 +406,7 @@ class SignatureService:
 
 
 __all__ = [
+    "BackendUnavailable",
     "CryptoError",
     "Digest",
     "sha512_digest",

@@ -21,7 +21,7 @@ from __future__ import annotations
 
 import threading
 
-from . import CryptoError, get_backend, set_backend
+from . import BackendUnavailable, CryptoError, get_backend, set_backend
 
 
 class _Request:
@@ -78,30 +78,49 @@ class BatchingBackend:
         if not batch:
             return
         self.fused_requests += len(batch)
-        msgs = [m for r in batch for m in r.msgs]
-        pubs = [p for r in batch for p in r.pubs]
-        sigs = [s for r in batch for s in r.sigs]
+        fused_ok = False
         try:
-            self.inner_calls += 1
-            if len(msgs) <= self.max_sigs:
-                self.inner.verify_batch(msgs, pubs, sigs)
-            else:
-                # Oversized fusion: verify per request (still one call per
-                # QC, the non-fused baseline).
-                raise CryptoError("fused batch too large")
-        except CryptoError:
-            # Isolate: one bad request must not fail its neighbors.
+            msgs = [m for r in batch for m in r.msgs]
+            pubs = [p for r in batch for p in r.pubs]
+            sigs = [s for r in batch for s in r.sigs]
+            try:
+                self.inner_calls += 1
+                if len(msgs) <= self.max_sigs:
+                    self.inner.verify_batch(msgs, pubs, sigs)
+                    fused_ok = True
+                else:
+                    # Oversized fusion: verify per request (still one call
+                    # per QC, the non-fused baseline).
+                    raise CryptoError("fused batch too large")
+            except Exception:
+                # Isolate: one bad request must not fail its neighbors —
+                # and a NON-crypto failure (JAX RuntimeError, device/tunnel
+                # death) must fail loudly, not wedge every waiter.
+                for r in batch:
+                    try:
+                        self.inner_calls += 1
+                        self.inner.verify_batch(r.msgs, r.pubs, r.sigs)
+                    except CryptoError as e:
+                        r.error = e
+                    except Exception as e:
+                        # Distinguishable from an invalid signature: the
+                        # request was NOT judged (transient infrastructure
+                        # failure, e.g. device/tunnel death).
+                        r.error = BackendUnavailable(
+                            f"verification backend failure: {e!r}"
+                        )
+                    finally:
+                        r.done.set()
+        finally:
+            # Nobody may be left waiting. A request released without having
+            # been verified is REJECTED (error set), never accepted.
             for r in batch:
-                try:
-                    self.inner_calls += 1
-                    self.inner.verify_batch(r.msgs, r.pubs, r.sigs)
-                except CryptoError as e:
-                    r.error = e
-                finally:
+                if not r.done.is_set():
+                    if not fused_ok and r.error is None:
+                        r.error = BackendUnavailable(
+                            "verification flush aborted"
+                        )
                     r.done.set()
-            return
-        for r in batch:
-            r.done.set()
 
 
 def enable_superbatching(window_ms: float = 2.0, max_sigs: int = 8192) -> BatchingBackend:

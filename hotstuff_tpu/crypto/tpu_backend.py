@@ -8,7 +8,7 @@ verifier in ``hotstuff_tpu.ops`` — the north-star offload of the QC hot path
 
 from __future__ import annotations
 
-from . import CryptoError
+from . import BackendUnavailable, CryptoError
 
 
 class TpuBackend:
@@ -29,5 +29,10 @@ class TpuBackend:
             raise CryptoError("batch length mismatch")
         if not msgs:
             return
-        if not self._ops.verify_batch_device(msgs, pubs, sigs):
+        try:
+            ok = self._ops.verify_batch_device(msgs, pubs, sigs)
+        except Exception as e:
+            # Device/runtime failure: the batch was NOT judged.
+            raise BackendUnavailable(f"device verification failed: {e!r}") from e
+        if not ok:
             raise CryptoError("invalid signature in batch (device)")

@@ -87,6 +87,10 @@ class LogEngine:
             value = data[pos + _HDR.size + klen : end]
             self._index[key] = value
             pos = end
+        if pos < len(data):
+            # Torn tail: truncate before reopening for append, or the next
+            # replay would misparse records written after the garbage bytes.
+            os.truncate(self._log_path, pos)
 
     def put(self, key: bytes, value: bytes) -> None:
         self._log.write(_HDR.pack(len(key), len(value)) + key + value)

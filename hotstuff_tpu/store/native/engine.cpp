@@ -20,6 +20,8 @@
 #include <unordered_map>
 #include <vector>
 
+#include <unistd.h>  // truncate
+
 extern "C" {
 
 struct HsStore {
@@ -31,16 +33,36 @@ struct HsStore {
 static bool replay(HsStore* s, const std::string& path) {
     FILE* f = std::fopen(path.c_str(), "rb");
     if (!f) return true;  // fresh database
+    std::fseek(f, 0, SEEK_END);
+    long file_size = std::ftell(f);
+    std::fseek(f, 0, SEEK_SET);
+    long valid_end = 0;  // offset just past the last complete record
     for (;;) {
         uint32_t hdr[2];
         size_t n = std::fread(hdr, 1, sizeof hdr, f);
         if (n < sizeof hdr) break;  // clean EOF or torn header: stop
+        // Bound lengths by the remaining file size before allocating: a
+        // torn header can decode to multi-GB lengths and bad_alloc must
+        // not escape the C ABI.
+        long remaining = file_size - std::ftell(f);
+        if (remaining < 0 ||
+            static_cast<uint64_t>(hdr[0]) + hdr[1] >
+                static_cast<uint64_t>(remaining))
+            break;  // torn record: stop
         std::string key(hdr[0], '\0'), val(hdr[1], '\0');
         if (std::fread(key.data(), 1, hdr[0], f) != hdr[0]) break;
         if (std::fread(val.data(), 1, hdr[1], f) != hdr[1]) break;
         s->index[std::move(key)] = std::move(val);
+        valid_end = std::ftell(f);
     }
+    std::fseek(f, 0, SEEK_END);
+    long file_end = std::ftell(f);
     std::fclose(f);
+    if (file_end > valid_end) {
+        // Torn tail: truncate before reopening for append, or the next
+        // replay would misparse records written after the garbage bytes.
+        if (truncate(path.c_str(), valid_end) != 0) return false;
+    }
     return true;
 }
 

@@ -64,6 +64,36 @@ async def test_spoofed_vote_cannot_displace_honest_vote():
 
 
 @async_test
+async def test_spoofed_bogus_digest_cannot_block_honest_vote():
+    """Cross-bucket displacement: a garbage signature under an honest
+    author's key voting for a FABRICATED digest arrives first; the genuine
+    vote for the real proposal must still be re-seated and the QC form."""
+    from hotstuff_tpu.crypto import sha512_digest
+
+    committee = consensus_committee(BASE + 60)
+    blocks = chain(1)
+    me = leader_index(committee, 2)
+    node = spawn_core(me, committee, batch_vote_verification=True)
+
+    spoof = Vote(sha512_digest(b"bogus"), 1, keys()[0][0], Signature(b"\x09" * 64))
+    await node["rx"].put(("vote", spoof))  # binds author 0 to a bogus bucket
+    await asyncio.sleep(0.05)
+    good = [
+        Vote.new_from_key(blocks[0].digest(), 1, pk, sk) for pk, sk in keys()
+    ]
+    await node["rx"].put(("vote", good[1]))
+    await node["rx"].put(("vote", good[2]))
+    await node["rx"].put(("vote", good[0]))  # must evict the bogus entry
+    while True:
+        msg = await asyncio.wait_for(node["proposer"].get(), 5)
+        if isinstance(msg, Make) and msg.round == 2:
+            assert msg.qc.hash == blocks[0].digest()
+            break
+    node["task"].cancel()
+    node["sync"].shutdown()
+
+
+@async_test
 async def test_future_round_votes_bounded():
     """Votes absurdly far in the future are dropped, not aggregated."""
     committee = consensus_committee(BASE + 30)
@@ -115,18 +145,103 @@ def test_rebuild_emits_qc_when_good_votes_meet_quorum():
     rebuilt.verify(committee)
 
 
-def test_aggregator_per_round_digest_bound():
+def test_aggregator_one_bucket_per_author():
+    """A byzantine member signing votes for many fabricated digests can
+    occupy at most ONE digest bucket per round — honest votes for the real
+    proposal are never displaced (liveness-DoS fix)."""
+    import pytest
+
     from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.consensus.errors import AuthorityReuse
     from hotstuff_tpu.crypto import sha512_digest
 
     committee = consensus_committee(BASE + 40)
     agg = Aggregator(committee)
     pk, sk = keys()[0]
-    cap = Aggregator.MAX_DIGESTS_PER_ROUND_FACTOR * committee.size()
-    for i in range(cap + 5):
+    agg.add_vote(Vote(sha512_digest(b"digest0"), 3, pk, Signature(b"\x01" * 64)))
+    for i in range(1, 10):
         v = Vote(sha512_digest(b"digest%d" % i), 3, pk, Signature(b"\x01" * 64))
-        agg.add_vote(v)
-    assert len(agg.votes_aggregators[3]) == cap
+        with pytest.raises(AuthorityReuse):
+            agg.add_vote(v)
+    assert len(agg.votes_aggregators[3]) == 1
+    # Honest votes for the real digest still aggregate to a QC.
+    block = chain(1)[0]
+    qc = None
+    for hpk, hsk in keys()[1:4]:
+        qc = agg.add_vote(Vote.new_from_key(block.digest(), 3, hpk, hsk))
+    assert qc is not None and qc.hash == block.digest()
+
+
+def test_reseat_vote_moves_author_across_buckets():
+    """Cross-bucket conflict: a (spoofed or equivocating) entry under an
+    author's key in a bogus-digest bucket is evicted when the author's
+    verified vote for the real digest is re-seated; the empty bogus bucket
+    is garbage-collected and the re-seat can complete a quorum."""
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.crypto import sha512_digest
+
+    committee = consensus_committee(BASE + 50)
+    agg = Aggregator(committee)
+    block = chain(1)[0]
+    ks = keys()
+    bogus = Vote(sha512_digest(b"bogus"), 1, ks[0][0], Signature(b"\x02" * 64))
+    agg.add_vote(bogus)
+    for pk, sk in ks[1:3]:
+        assert agg.add_vote(Vote.new_from_key(block.digest(), 1, pk, sk)) is None
+    genuine = Vote.new_from_key(block.digest(), 1, ks[0][0], ks[0][1])
+    qc = agg.reseat_vote(genuine)  # 3rd vote: completes 2f+1
+    assert qc is not None and qc.hash == block.digest()
+    qc.verify(committee)
+    assert bogus.digest() not in agg.votes_aggregators[1]  # bucket GC'd
+
+
+@async_test
+async def test_backend_outage_does_not_blacklist_honest_votes():
+    """A transient device/tunnel failure during QC batch verification must
+    NOT classify the honest signatures as byzantine: after the backend
+    recovers, a resend of one vote completes the quorum and the QC forms."""
+    from hotstuff_tpu import crypto as crypto_mod
+    from hotstuff_tpu.crypto import BackendUnavailable, get_backend
+
+    committee = consensus_committee(BASE + 70)
+    blocks = chain(1)
+    me = leader_index(committee, 2)
+
+    real = get_backend()
+
+    class OutageBackend:
+        name = "outage"
+        fail = True
+
+        def verify_batch(self, msgs, pubs, sigs):
+            if OutageBackend.fail:
+                raise BackendUnavailable("tunnel died")
+            real.verify_batch(msgs, pubs, sigs)
+
+        def __getattr__(self, item):
+            return getattr(real, item)
+
+    try:
+        crypto_mod._BACKEND = OutageBackend()
+        node = spawn_core(me, committee, batch_vote_verification=True)
+        good = [
+            Vote.new_from_key(blocks[0].digest(), 1, pk, sk) for pk, sk in keys()
+        ]
+        for v in good[:3]:
+            await node["rx"].put(("vote", v))  # 3rd completes 2f+1 -> outage
+        await asyncio.sleep(0.1)
+        assert node["proposer"].empty()
+        assert not node["task"].done(), "core died on backend outage"
+        OutageBackend.fail = False  # tunnel recovers; bounded retry fires
+        while True:
+            msg = await asyncio.wait_for(node["proposer"].get(), 5)
+            if isinstance(msg, Make) and msg.round == 2:
+                assert msg.qc.hash == blocks[0].digest()
+                break
+        node["task"].cancel()
+        node["sync"].shutdown()
+    finally:
+        crypto_mod._BACKEND = real
 
 
 @async_test

@@ -33,6 +33,34 @@ async def test_helper_serves_stored_block():
 
 
 @async_test
+async def test_helper_survives_corrupt_stored_block():
+    """A corrupt stored block must not kill the helper task: later requests
+    for healthy blocks are still served."""
+    committee = consensus_committee(BASE + 20)
+    store = Store()
+    block = chain(1)[0]
+    await store.write(block.digest().data, block.serialize())
+    from hotstuff_tpu.crypto import sha512_digest
+
+    corrupt = sha512_digest(b"corrupt")
+    await store.write(corrupt.data, b"\xff garbage not a block")
+
+    rx: asyncio.Queue = asyncio.Queue()
+    helper_task = Helper.spawn(committee, store, rx)
+    requestor = keys()[1][0]
+    await rx.put((corrupt, requestor))  # deserialization fails
+    await asyncio.sleep(0.1)
+    assert not helper_task.done(), "helper died on a corrupt stored block"
+
+    task = asyncio.create_task(listener(committee.address(requestor)[1]))
+    await asyncio.sleep(0.05)
+    await rx.put((block.digest(), requestor))
+    frame = await asyncio.wait_for(task, 5)
+    kind, replied = decode_message(frame)
+    assert kind == "propose" and replied.digest() == block.digest()
+
+
+@async_test
 async def test_helper_ignores_unknown_digest_and_stranger():
     from hotstuff_tpu.crypto import generate_keypair, sha512_digest
 

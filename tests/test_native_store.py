@@ -72,6 +72,45 @@ def test_torn_tail_replay(tmp_path):
     eng2.close()
 
 
+def test_torn_tail_double_restart(tmp_path):
+    """Crash -> restart -> write -> restart keeps the post-crash write
+    (replay truncates the torn tail before reopening for append)."""
+    path = str(tmp_path / "db")
+    eng = NativeEngine(path)
+    eng.put(b"good", b"value")
+    eng.close()
+    with open(os.path.join(path, "store.log"), "ab") as f:
+        f.write(b"\x10\x00\x00\x00\x10\x00")
+    eng2 = NativeEngine(path)
+    eng2.put(b"after-crash", b"kept")
+    eng2.close()
+    eng3 = NativeEngine(path)
+    assert eng3.get(b"good") == b"value"
+    assert eng3.get(b"after-crash") == b"kept"
+    # No garbage keys: exactly the two real records survived.
+    assert eng3._lib.hs_store_size(eng3._handle) == 2
+    eng3.close()
+
+
+def test_torn_tail_huge_length_header(tmp_path):
+    """A torn header decoding to multi-GB lengths must be truncated, not
+    attempted as an allocation (bad_alloc across the C ABI aborts)."""
+    path = str(tmp_path / "db")
+    eng = NativeEngine(path)
+    eng.put(b"good", b"value")
+    eng.close()
+    with open(os.path.join(path, "store.log"), "ab") as f:
+        f.write(b"\xff\xff\xff\xff\xff\xff\xff\xff tail")  # klen=vlen=4GiB-1
+    eng2 = NativeEngine(path)
+    assert eng2.get(b"good") == b"value"
+    eng2.put(b"after", b"kept")
+    eng2.close()
+    eng3 = NativeEngine(path)
+    assert eng3.get(b"after") == b"kept"
+    assert eng3._lib.hs_store_size(eng3._handle) == 2
+    eng3.close()
+
+
 def test_meta_records(tmp_path):
     eng = NativeEngine(str(tmp_path / "db"))
     assert eng.get_meta(b"state") is None

@@ -90,3 +90,22 @@ def test_torn_tail_replay(tmp_path):
     eng2 = LogEngine(path)
     assert eng2.get(b"good") == b"value"
     eng2.close()
+
+
+def test_torn_tail_double_restart(tmp_path):
+    """Crash -> restart -> write -> restart must keep the post-crash write:
+    replay truncates the torn tail so new records never land behind garbage."""
+    path = str(tmp_path / "db")
+    eng = LogEngine(path)
+    eng.put(b"good", b"value")
+    eng.close()
+    with open(os.path.join(path, "store.log"), "ab") as f:
+        f.write(b"\x10\x00\x00\x00\x10")  # torn half-record
+    eng2 = LogEngine(path)
+    eng2.put(b"after-crash", b"kept")
+    eng2.close()
+    eng3 = LogEngine(path)
+    assert eng3.get(b"good") == b"value"
+    assert eng3.get(b"after-crash") == b"kept"
+    assert set(eng3._index) == {b"good", b"after-crash"}  # no garbage keys
+    eng3.close()

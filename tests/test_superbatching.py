@@ -114,6 +114,43 @@ def test_backend_variant_names():
     assert get_backend() is backend
 
 
+def test_device_failure_does_not_wedge_waiters():
+    """A NON-crypto exception from the inner backend (JAX RuntimeError,
+    device/tunnel death) must release every fused waiter with a CryptoError
+    — not propagate into one caller while the rest block forever."""
+
+    class DyingBackend(CpuBackend):
+        def verify_batch(self, msgs, pubs, sigs):
+            raise RuntimeError("device tunnel died")
+
+    backend = BatchingBackend(DyingBackend(), window_ms=50)
+    requests = [make_request(tag=b"w%d" % i) for i in range(4)]
+    errors = _run_threads(backend, requests)
+    assert all(isinstance(e, CryptoError) for e in errors), errors
+    assert all("backend failure" in str(e) for e in errors)
+
+
+def test_partial_device_failure_isolates_to_healthy_path():
+    """Fused call dies with a non-crypto error, but per-request retries
+    succeed: every waiter must be released with the correct verdict."""
+
+    class FlakyBackend(CpuBackend):
+        def __init__(self):
+            super().__init__()
+            self.first = True
+
+        def verify_batch(self, msgs, pubs, sigs):
+            if self.first:
+                self.first = False
+                raise RuntimeError("transient device error")
+            super().verify_batch(msgs, pubs, sigs)
+
+    backend = BatchingBackend(FlakyBackend(), window_ms=50)
+    requests = [make_request(tag=b"f%d" % i) for i in range(3)]
+    errors = _run_threads(backend, requests)
+    assert errors == [None] * 3, errors
+
+
 def test_enable_superbatching_idempotent():
     from hotstuff_tpu.crypto.batching import enable_superbatching
 
