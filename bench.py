@@ -18,6 +18,40 @@ import time
 
 import numpy as np
 
+from hotstuff_tpu.utils.jaxcache import enable_persistent_cache
+
+# Must run before the first jit compile: cold-compiling the mega-kernels
+# costs tens of seconds; the persistent cache drops later runs to a disk
+# read, which is what lets this bench fit its budget even after a process
+# restart or a flaky first attempt.
+enable_persistent_cache()
+
+
+def probe_device(attempts: int = 4, backoff_s: float = 5.0) -> None:
+    """Cheap device-aliveness check with bounded retry.
+
+    A trivial op round-trip (no custom kernels) distinguishes "tunnel is
+    down" from "compile is slow" in seconds instead of burning the whole
+    budget on a doomed warm-up. Raises the last error if all attempts fail.
+    """
+    import jax
+    import jax.numpy as jnp
+
+    last: Exception | None = None
+    for attempt in range(attempts):
+        try:
+            jnp.zeros(8).block_until_ready()
+            return
+        except Exception as exc:  # noqa: BLE001 — any device error retries
+            last = exc
+            print(
+                f"device probe attempt {attempt + 1}/{attempts} failed: {exc!r}",
+                file=sys.stderr,
+                flush=True,
+            )
+            time.sleep(backoff_s * (2**attempt))
+    raise last if last is not None else RuntimeError("unreachable")
+
 
 def make_batch(n_sigs: int, seed: int = 2024):
     from hotstuff_tpu.crypto import ed25519_ref as ref
@@ -46,6 +80,7 @@ def bench_device(msgs, pubs, sigs, iters: int = 8, threads: int = 4) -> float:
 
     from hotstuff_tpu.ops.verify import _compiled, prepare_batch, verify_batch_device
 
+    probe_device()
     rng = random.Random(1)
     assert verify_batch_device(msgs, pubs, sigs, _rng=rng)  # warm-up/compile
 
@@ -73,6 +108,7 @@ def bench_device(msgs, pubs, sigs, iters: int = 8, threads: int = 4) -> float:
 
 
 def bench_cpu(msgs, pubs, sigs, iters: int = 2) -> float:
+    """Serial per-signature CPU verification (OpenSSL)."""
     from hotstuff_tpu.crypto import CpuBackend
 
     backend = CpuBackend()
@@ -83,6 +119,22 @@ def bench_cpu(msgs, pubs, sigs, iters: int = 2) -> float:
     return (time.perf_counter() - t0) / iters
 
 
+def bench_cpu_batch(msgs, pubs, sigs) -> float:
+    """Batched CPU verification: dalek ``verify_batch`` semantics AND
+    algorithm (RLC + MSM, reference ``crypto/src/lib.rs:206-219``).
+
+    Uses the fastest batch implementation available on this host: the
+    native C++ engine when built, else the pure-Python Pippenger."""
+    from hotstuff_tpu.crypto import cpu_batch
+
+    verify = cpu_batch.best_verify_batch()
+    rng = random.Random(11)
+    assert verify(msgs, pubs, sigs, rng=rng)  # warm-up + correctness
+    t0 = time.perf_counter()
+    assert verify(msgs, pubs, sigs, rng=rng)
+    return time.perf_counter() - t0
+
+
 def main() -> None:
     # Committee-1000 regime: a QC carries 2f+1 = 667 votes; batching two
     # in-flight QCs ~ 1343 signatures -> 2687 MSM lanes -> 4096 padded.
@@ -91,6 +143,12 @@ def main() -> None:
     msgs, pubs, sigs = make_batch(n_sigs)
     cpu_s = bench_cpu(msgs, pubs, sigs)
     cpu_us_per_sig = cpu_s / n_sigs * 1e6
+    cpu_batch_s = bench_cpu_batch(msgs, pubs, sigs)
+    cpu_batch_us_per_sig = cpu_batch_s / n_sigs * 1e6
+    # The HONEST baseline is the fastest CPU option on this host: serial
+    # native (OpenSSL) vs batched (RLC+MSM). vs_serial and vs_batch are
+    # reported separately alongside it.
+    best_cpu_us = min(cpu_us_per_sig, cpu_batch_us_per_sig)
 
     # The TPU is reached through a tunnel that can go down; a hung device
     # call must not wedge the benchmark forever. Run the device benchmark
@@ -103,8 +161,22 @@ def main() -> None:
     # Covers a full cold compile (~400 s worst observed) with margin, while
     # staying comfortably inside typical harness timeouts.
     budget = float(os.environ.get("HOTSTUFF_BENCH_TIMEOUT", "600"))
+
+    def device_with_retry():
+        # A transient tunnel error (reset connection, lost heartbeat) often
+        # clears in seconds; one bounded retry converts those runs from a
+        # fallback artifact into a real number. Hangs are still handled by
+        # the outer budget timeout.
+        try:
+            return bench_device(msgs, pubs, sigs)
+        except Exception as exc:  # noqa: BLE001
+            print(f"device bench attempt 1 failed, retrying: {exc!r}", file=sys.stderr, flush=True)
+            time.sleep(10)
+            probe_device()
+            return bench_device(msgs, pubs, sigs)
+
     with ThreadPoolExecutor(1) as ex:
-        fut = ex.submit(bench_device, msgs, pubs, sigs)
+        fut = ex.submit(device_with_retry)
         def fallback(reason_suffix: str, code: int = 0) -> None:
             # Always emit the one promised JSON line (honest CPU-only
             # numbers, explicitly labeled) and exit immediately — a hung
@@ -114,9 +186,11 @@ def main() -> None:
                 json.dumps(
                     {
                         "metric": f"ed25519_qc_batch_verify_{n_sigs}sigs_{reason_suffix}_cpu_only",
-                        "value": round(cpu_us_per_sig, 3),
+                        "value": round(best_cpu_us, 3),
                         "unit": "us/sig",
                         "vs_baseline": 1.0,
+                        "cpu_serial_us": round(cpu_us_per_sig, 3),
+                        "cpu_batch_us": round(cpu_batch_us_per_sig, 3),
                     }
                 ),
                 flush=True,
@@ -146,7 +220,11 @@ def main() -> None:
                 "metric": f"ed25519_qc_batch_verify_{n_sigs}sigs",
                 "value": round(us_per_sig, 3),
                 "unit": "us/sig",
-                "vs_baseline": round(cpu_us_per_sig / us_per_sig, 3),
+                "vs_baseline": round(best_cpu_us / us_per_sig, 3),
+                "vs_serial": round(cpu_us_per_sig / us_per_sig, 3),
+                "vs_batch": round(cpu_batch_us_per_sig / us_per_sig, 3),
+                "cpu_serial_us": round(cpu_us_per_sig, 3),
+                "cpu_batch_us": round(cpu_batch_us_per_sig, 3),
             }
         )
     )

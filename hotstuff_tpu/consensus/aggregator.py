@@ -129,30 +129,41 @@ class Aggregator:
             timeout.round, TCMaker()
         ).append(timeout, self.committee)
 
-    def rebuild_votes(self, round_: Round, digest, good_votes, hash_) -> QC | None:
-        """After a batch-verified QC failed, reinstate only the good votes
-        for (round, block digest) so aggregation continues; ejected authors
-        may vote again (their next signature may be honest).
+    def eject_votes(self, round_: Round, digest, bad, hash_):
+        """After a batch-verified QC failed: remove the given bad
+        ``(author, signature)`` pairs from the CURRENT maker for
+        (round, block digest) and free those authors' buckets so they may
+        vote again (their next signature may be honest).
 
-        With unequal stakes the surviving votes may already meet the quorum
-        threshold (the bad vote was not load-bearing): emit that QC now —
-        its signatures were individually verified during ejection — instead
-        of stalling on a vote that may never come."""
-        maker = QCMaker()
-        maker.votes = list(good_votes)
-        maker.used = {pk for pk, _ in good_votes}
-        maker.weight = sum(self.committee.stake(pk) for pk, _ in good_votes)
-        self.votes_aggregators.setdefault(round_, {})[digest] = maker
-        buckets = self.author_bucket.setdefault(round_, {})
-        for pk in [a for a, d in buckets.items() if d == digest]:
-            if pk not in maker.used:
-                del buckets[pk]  # ejected: free to vote again
-        for pk in maker.used:
-            buckets[pk] = digest
+        This is keyed by the exact (author, signature) pair, not by author:
+        an author whose spoofed signature appears in a stale QC snapshot
+        but whose seat has since been replaced by an individually-verified
+        genuine signature keeps the genuine vote.
+
+        Returns ``(qc, ejected_authors)``: with unequal stakes the
+        surviving votes may already meet the quorum threshold (the bad
+        vote was not load-bearing) — the caller re-verifies any emitted QC
+        since survivors may include later, not-yet-verified seatings."""
+        maker = self.votes_aggregators.get(round_, {}).get(digest)
+        if maker is None:
+            return None, set()
+        bad_keys = {(bytes(pk.data), bytes(sig.data)) for pk, sig in bad}
+        survivors = [
+            (pk, sig)
+            for pk, sig in maker.votes
+            if (bytes(pk.data), bytes(sig.data)) not in bad_keys
+        ]
+        ejected = {pk for pk, _ in maker.votes} - {pk for pk, _ in survivors}
+        maker.votes = survivors
+        maker.used = {pk for pk, _ in survivors}
+        maker.weight = sum(self.committee.stake(pk) for pk, _ in survivors)
+        buckets = self.author_bucket.get(round_, {})
+        for pk in ejected:
+            buckets.pop(pk, None)
         if maker.weight >= self.committee.quorum_threshold():
             maker.weight = 0  # QC emitted exactly once
-            return QC(hash=hash_, round=round_, votes=list(maker.votes))
-        return None
+            return QC(hash=hash_, round=round_, votes=list(maker.votes)), ejected
+        return None, ejected
 
     def replace_vote(self, vote: Vote) -> None:
         """Swap an author's stored (unverified) vote for a newly verified

@@ -333,28 +333,53 @@ class Core:
         """A batch-verified QC failed: identify the byzantine signatures
         (off the event loop — this is 2f+1 serial verifies), record them so
         resends drop cheaply, and keep the good votes aggregating. Returns
-        a QC if the surviving votes already meet the quorum threshold."""
-        digest = qc.digest()
+        a QC if the surviving votes already meet the quorum threshold.
 
-        def split():
-            good, bad = [], []
-            for pk, sig in qc.votes:
-                try:
-                    sig.verify(digest, pk)
-                    good.append((pk, sig))
-                except BackendUnavailable:
-                    raise  # NOT judged: never classify as byzantine
-                except CryptoError:
-                    bad.append((pk, sig))
-            return good, bad
+        Loops because ejection operates on the aggregator's CURRENT maker:
+        votes seated after the failing QC was assembled may be unverified
+        (batched mode), so a re-emitted QC is split again until every
+        signature in it verified individually. Each iteration with bad
+        signatures removes at least one vote, so the loop is bounded by
+        committee size."""
+        current = qc
+        for _ in range(len(self.committee.authorities) + 1):
+            digest = current.digest()
 
-        good, bad = await verify_off_loop(split)
-        for pk, sig in bad:
-            log.warning("ejecting invalid vote signature from %s", pk)
-            self._record_bad(
-                qc.round, bytes(pk.data) + sig.data + qc.hash.data
+            def split(votes=current.votes, digest=digest):
+                good, bad = [], []
+                for pk, sig in votes:
+                    try:
+                        sig.verify(digest, pk)
+                        good.append((pk, sig))
+                    except BackendUnavailable:
+                        raise  # NOT judged: never classify as byzantine
+                    except CryptoError:
+                        bad.append((pk, sig))
+                return good, bad
+
+            _, bad = await verify_off_loop(split)
+            if not bad:
+                # Every signature verified individually (a stricter check
+                # than the failed cofactored batch): the QC stands.
+                return current
+            for pk, sig in bad:
+                log.warning("ejecting invalid vote signature from %s", pk)
+                self._record_bad(
+                    current.round, bytes(pk.data) + sig.data + current.hash.data
+                )
+            next_qc, ejected = self.aggregator.eject_votes(
+                current.round, digest, bad, current.hash
             )
-        return self.aggregator.rebuild_votes(qc.round, digest, good, qc.hash)
+            # An ejected author's seat no longer holds a verified vote;
+            # forgetting the seat lets their genuine resend be verified
+            # and re-seated instead of being dropped as a replay.
+            seats = self._verified_seats.get(current.round)
+            if seats is not None:
+                seats.difference_update(ejected)
+            if next_qc is None:
+                return None
+            current = next_qc
+        return None
 
     @staticmethod
     def _vote_key(vote: Vote) -> bytes:

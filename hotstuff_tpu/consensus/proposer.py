@@ -96,7 +96,7 @@ class Proposer:
         serialized = encode_propose(block)
         names_addresses = self.committee.broadcast_addresses(self.name)
         handlers = [
-            (name, self.network.send(addr, serialized))
+            (name, await self.network.send(addr, serialized))
             for name, addr in names_addresses
         ]
         await self.tx_loopback.put(block)

@@ -1,0 +1,115 @@
+"""CPU batched Ed25519 verification: RLC + Pippenger multi-scalar mul.
+
+The honest CPU bar from the reference: dalek's ``verify_batch``
+(``/root/reference/crypto/src/lib.rs:206-219``) is not a serial loop — it
+folds the whole batch into ONE multi-scalar multiplication
+
+    8·[ (-sum z_i s_i mod L)·B + sum z_i·R_i + sum (z_i h_i mod L)·A_i ] == O
+
+with random 128-bit z_i, evaluated by a Straus/Pippenger MSM. This module
+implements the same equation with the same algorithm (bucketed Pippenger,
+window size chosen by batch size) in pure Python over ``ed25519_ref``'s
+extended coordinates, so ``bench.py`` can report the device speedup against
+batch-verify *semantics and algorithm*, not just against a serial loop.
+
+Pure Python big-int arithmetic is the limit here (~2 µs per point add); on
+this box the native serial OpenSSL loop and this batched verifier land in
+the same range, and bench.py reports both honestly.
+"""
+
+from __future__ import annotations
+
+import secrets
+
+from .ed25519_ref import (
+    G,
+    IDENTITY,
+    L,
+    compute_challenge,
+    is_identity,
+    point_add,
+    point_double,
+    point_decompress,
+    point_mul,
+)
+
+
+def best_verify_batch():
+    """The fastest CPU batch-verify implementation available on this host:
+    the native C++ engine when its shared library is built, else the
+    pure-Python Pippenger below. Both take ``(msgs, pubs, sigs, rng=...)``."""
+    try:
+        from .native_ed25519 import native_available, verify_batch_native
+
+        if native_available():
+            return verify_batch_native
+    except ImportError:
+        pass
+    return verify_batch_rlc_pippenger
+
+
+def _pippenger(scalars: list[int], points: list, c: int) -> tuple:
+    """Bucketed MSM: sum scalars[i] * points[i], window width ``c`` bits."""
+    n_windows = (max(s.bit_length() for s in scalars) + c - 1) // c if scalars else 1
+    acc = IDENTITY
+    for w in range(n_windows - 1, -1, -1):
+        if acc is not IDENTITY:
+            for _ in range(c):
+                acc = point_double(acc)
+        buckets: dict[int, tuple] = {}
+        shift = w * c
+        mask = (1 << c) - 1
+        for s, pt in zip(scalars, points):
+            d = (s >> shift) & mask
+            if d == 0:
+                continue
+            cur = buckets.get(d)
+            buckets[d] = pt if cur is None else point_add(cur, pt)
+        if not buckets:
+            continue
+        # Bucket sweep: sum_d d * bucket[d] via running suffix sums.
+        running = IDENTITY
+        window_sum = IDENTITY
+        for d in range(max(buckets), 0, -1):
+            pt = buckets.get(d)
+            if pt is not None:
+                running = point_add(running, pt)
+            window_sum = point_add(window_sum, running)
+        acc = point_add(acc, window_sum)
+    return acc
+
+
+def verify_batch_rlc_pippenger(msgs, pubs, sigs, rng=None, c: int = 8) -> bool:
+    """Batch verification, dalek ``verify_batch`` algorithm on CPU.
+
+    msgs/pubs/sigs: equal-length lists of bytes. True iff the whole batch
+    verifies under cofactored semantics. Rejects non-canonical encodings
+    host-side exactly like the device pipeline (``ops.verify``).
+    """
+    randbits = rng.getrandbits if rng is not None else secrets.randbits
+
+    scalars: list[int] = []
+    points: list = []
+    b_coeff = 0
+    for msg, pub, sig in zip(msgs, pubs, sigs):
+        if len(sig) != 64 or len(pub) != 32:
+            return False
+        a_pt = point_decompress(pub)
+        r_pt = point_decompress(sig[:32])
+        if a_pt is None or r_pt is None:
+            return False
+        s = int.from_bytes(sig[32:], "little")
+        if s >= L:
+            return False
+        z = randbits(128) | (1 << 127)
+        h = compute_challenge(sig[:32], pub, msg)
+        b_coeff = (b_coeff + z * s) % L
+        scalars.append(z)
+        points.append(r_pt)
+        scalars.append(z * h % L)
+        points.append(a_pt)
+    scalars.append((-b_coeff) % L)
+    points.append(G)
+
+    acc = _pippenger(scalars, points, c)
+    return is_identity(point_mul(8, acc))

@@ -87,7 +87,7 @@ class BatchMaker:
             log.info("Batch %s contains %d B", digest, size)
 
         handlers = [
-            (name, self.network.send(address, serialized))
+            (name, await self.network.send(address, serialized))
             for name, address in self.mempool_addresses
         ]
         await self.tx_message.put(QuorumWaiterMessage(serialized, handlers))

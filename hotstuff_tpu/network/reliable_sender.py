@@ -40,13 +40,33 @@ class _Connection:
         host, port = self.address
         delay = RETRY_DELAY_MS
         while True:
+            # While disconnected — including DURING the connect attempt,
+            # which can block for the kernel SYN-retry timeout on a
+            # blackholed peer — keep draining the queue into ``pending`` and
+            # prune cancelled messages, so senders back-pressured by ``send``
+            # are never blocked by a DEAD peer, only by a slow live one.
+            # Callers that give up (e.g. the proposer after 2f+1 ACKs)
+            # cancel their handlers, which frees the buffered slots here
+            # (reference ``reliable_sender.rs:160-177`` selects over
+            # connect-retry and channel drain the same way).
+            drain = asyncio.create_task(self._drain_while_disconnected())
             try:
-                reader, writer = await asyncio.open_connection(host, port)
-            except OSError as e:
-                log.debug("retrying %s:%d in %dms: %s", host, port, delay, e)
-                await asyncio.sleep(delay / 1000)
-                delay = min(delay * 2, RETRY_CAP_MS)
-                continue
+                while True:
+                    try:
+                        reader, writer = await asyncio.open_connection(host, port)
+                        break
+                    except OSError as e:
+                        log.debug(
+                            "retrying %s:%d in %dms: %s", host, port, delay, e
+                        )
+                        await asyncio.sleep(delay / 1000)
+                        delay = min(delay * 2, RETRY_CAP_MS)
+            finally:
+                drain.cancel()
+                try:
+                    await drain
+                except asyncio.CancelledError:
+                    pass
             delay = RETRY_DELAY_MS
             try:
                 await self._run(reader, writer)
@@ -54,6 +74,19 @@ class _Connection:
                 log.debug("connection to %s:%d dropped: %s", host, port, e)
             finally:
                 writer.close()
+
+    async def _drain_while_disconnected(self) -> None:
+        drained = 0
+        while True:
+            item = await self.queue.get()
+            self.pending.append(item)
+            drained += 1
+            # Amortized prune: a full deque rebuild per message would be
+            # O(n^2) over a long outage; _run re-prunes on reconnect.
+            if drained % 64 == 0:
+                self.pending = deque(
+                    (d, h) for d, h in self.pending if not h.cancelled()
+                )
 
     async def _run(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         # Replay un-ACKed, un-cancelled messages from the previous connection.
@@ -106,30 +139,30 @@ class ReliableSender:
             self._connections[address] = conn
         return conn
 
-    def send(self, address: tuple[str, int], data: bytes) -> CancelHandler:
+    async def send(self, address: tuple[str, int], data: bytes) -> CancelHandler:
         """Queue one frame for ``address``; the returned handler resolves
-        with the peer's ACK bytes (reference ``reliable_sender.rs:60-72``)."""
+        with the peer's ACK bytes (reference ``reliable_sender.rs:60-72``).
+
+        Awaits queue capacity: when a peer's channel is full the caller is
+        back-pressured, never dropped — "reliable" messages must not vanish
+        under load (the reference's ``send`` likewise awaits the channel)."""
         handler: CancelHandler = asyncio.get_running_loop().create_future()
         conn = self._connection(address)
-        try:
-            conn.queue.put_nowait((data, handler))
-        except asyncio.QueueFull:
-            handler.cancel()
-            log.warning("dropping reliable message to %s: channel full", address)
+        await conn.queue.put((data, handler))
         return handler
 
-    def broadcast(
+    async def broadcast(
         self, addresses: list[tuple[str, int]], data: bytes
     ) -> list[CancelHandler]:
-        return [self.send(addr, data) for addr in addresses]
+        return [await self.send(addr, data) for addr in addresses]
 
-    def lucky_broadcast(
+    async def lucky_broadcast(
         self, addresses: list[tuple[str, int]], data: bytes, nodes: int
     ) -> list[CancelHandler]:
         """Reliably send to ``nodes`` randomly-picked addresses (reference
         ``reliable_sender.rs:91-100``)."""
         picked = self._rng.sample(addresses, min(nodes, len(addresses)))
-        return [self.send(addr, data) for addr in picked]
+        return [await self.send(addr, data) for addr in picked]
 
     def shutdown(self) -> None:
         for conn in self._connections.values():

@@ -1,0 +1,41 @@
+"""Persistent XLA compilation cache, keyed under the repo.
+
+Cold-compiling the crypto mega-kernels costs tens of seconds (worst
+observed ~400 s when the device tunnel is slow); the persistent cache
+makes every later process start pay a disk read instead. Used by
+``bench.py``, the test suite conftest, and the node's TPU backend.
+
+The cache is per-backend (TPU executables and CPU executables hash
+differently), so tests (CPU) and bench (TPU) coexist in one directory.
+"""
+
+from __future__ import annotations
+
+import os
+
+_DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache")
+
+_enabled = False
+
+
+def enable_persistent_cache(cache_dir: str | None = None) -> str:
+    """Idempotently enable JAX's persistent compilation cache.
+
+    Returns the cache directory in use. Safe to call before or after the
+    backend initializes; must be called before the first ``jit`` compile
+    to benefit that compile.
+    """
+    global _enabled
+    cache_dir = cache_dir or os.environ.get("HOTSTUFF_JAX_CACHE", _DEFAULT_DIR)
+    if _enabled:
+        return cache_dir
+    os.makedirs(cache_dir, exist_ok=True)
+    import jax
+
+    jax.config.update("jax_compilation_cache_dir", cache_dir)
+    # Cache everything: the kernels here are few and large, so there is no
+    # benefit to the default size/time thresholds.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)
+    _enabled = True
+    return cache_dir

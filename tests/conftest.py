@@ -19,7 +19,25 @@ _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
 
+# Persistent XLA compile cache: the device-path tests cost ~570 s of CPU
+# XLA compilation per cold run; with the cache, repeat runs pay a disk
+# read. Same cache directory as bench.py (entries are keyed per backend).
+# Configured via env (read by jax at import) rather than enable_persistent
+# _cache() so tests that never touch jax don't pay the jax import here.
+_repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+_cache_dir = os.environ.setdefault(
+    "JAX_COMPILATION_CACHE_DIR", os.path.join(_repo, ".jax_cache")
+)
+os.makedirs(_cache_dir, exist_ok=True)
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
+os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
 if "jax" in sys.modules:
+    # jax read its env-derived config already: apply the same settings via
+    # jax.config so neither the CPU pin nor the cache is silently skipped.
     import jax
 
     jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_compilation_cache_dir", _cache_dir)
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    jax.config.update("jax_persistent_cache_min_entry_size_bytes", -1)

@@ -138,11 +138,50 @@ def test_rebuild_emits_qc_when_good_votes_meet_quorum():
     assert agg.add_vote(v_a) is None  # stake 2
     qc = agg.add_vote(v_c)  # stake 5 >= 4 -> QC (contains the bad sig)
     assert qc is not None
-    # Ejection keeps A (1) + C (3) = 4 >= quorum: rebuild must emit.
-    good = [(pk, sig) for pk, sig in qc.votes if pk != ks[1][0]]
-    rebuilt = agg.rebuild_votes(qc.round, qc.digest(), good, qc.hash)
+    # Ejection keeps A (1) + C (3) = 4 >= quorum: it must emit a QC.
+    bad = [(pk, sig) for pk, sig in qc.votes if pk == ks[1][0]]
+    rebuilt, ejected = agg.eject_votes(qc.round, qc.digest(), bad, qc.hash)
+    assert ejected == {ks[1][0]}
     assert rebuilt is not None
     rebuilt.verify(committee)
+
+
+def test_eject_votes_keeps_replaced_genuine_signature():
+    """Ejection is keyed by (author, signature): if an author's spoofed
+    signature from a stale QC snapshot was already swapped for their
+    individually-verified genuine one, ejecting the stale pair must keep
+    the genuine vote seated (and not report the author ejected)."""
+    from hotstuff_tpu.consensus import Authority, Committee
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.consensus.messages import Vote
+
+    ks = keys(3)
+    committee = Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", 1 + i))
+            for i, (pk, _) in enumerate(ks)
+        }
+    )
+    agg = Aggregator(committee)
+    block = chain(1)[0]
+    spoofed = Vote(block.digest(), 1, ks[1][0], Signature(b"\x07" * 64))
+    genuine = Vote.new_from_key(block.digest(), 1, ks[1][0], ks[1][1])
+    v_a = Vote.new_from_key(block.digest(), 1, ks[0][0], ks[0][1])
+    v_c = Vote.new_from_key(block.digest(), 1, ks[2][0], ks[2][1])
+
+    assert agg.add_vote(spoofed) is None
+    assert agg.add_vote(v_a) is None
+    stale_qc = agg.add_vote(v_c)  # quorum met; snapshot holds the spoof
+    assert stale_qc is not None
+    agg.replace_vote(genuine)  # core verified the genuine resend
+
+    bad = [(pk, sig) for pk, sig in stale_qc.votes if pk == ks[1][0]]
+    fixed, ejected = agg.eject_votes(
+        stale_qc.round, stale_qc.digest(), bad, stale_qc.hash
+    )
+    assert ejected == set()  # the genuine replacement survived
+    assert fixed is not None
+    fixed.verify(committee)  # all three signatures now genuine
 
 
 def test_aggregator_one_bucket_per_author():

@@ -71,7 +71,7 @@ async def test_reliable_send_resolves_with_ack():
     task = asyncio.create_task(listener(port, expected=b"important"))
     await asyncio.sleep(0.05)
     sender = ReliableSender()
-    handler = sender.send(("127.0.0.1", port), b"important")
+    handler = await sender.send(("127.0.0.1", port), b"important")
     assert await asyncio.wait_for(handler, 5) == b"Ack"
     await task
     sender.shutdown()
@@ -83,7 +83,7 @@ async def test_reliable_broadcast():
     tasks = [asyncio.create_task(listener(p)) for p in ports]
     await asyncio.sleep(0.05)
     sender = ReliableSender()
-    handlers = sender.broadcast([("127.0.0.1", p) for p in ports], b"rb")
+    handlers = await sender.broadcast([("127.0.0.1", p) for p in ports], b"rb")
     acks = await asyncio.gather(*handlers)
     assert acks == [b"Ack"] * 3
     await asyncio.gather(*tasks)
@@ -96,7 +96,7 @@ async def test_reliable_retry_before_listener_exists():
     listening; the listener appears later; the ACK still arrives."""
     port = BASE_PORT + 20
     sender = ReliableSender()
-    handler = sender.send(("127.0.0.1", port), b"retry-me")
+    handler = await sender.send(("127.0.0.1", port), b"retry-me")
     await asyncio.sleep(0.4)  # let at least one connect attempt fail
     assert not handler.done()
     payload = await asyncio.wait_for(
@@ -123,7 +123,7 @@ async def test_reliable_replays_unacked_on_reconnect():
 
     server = await asyncio.start_server(rude, "127.0.0.1", port)
     sender = ReliableSender()
-    handler = sender.send(("127.0.0.1", port), b"replay-me")
+    handler = await sender.send(("127.0.0.1", port), b"replay-me")
     assert await asyncio.wait_for(got_first, 5) == b"replay-me"
     server.close()
     await server.wait_closed()
@@ -143,7 +143,7 @@ async def test_reliable_lucky_broadcast():
     tasks = [asyncio.create_task(listener(p)) for p in ports]
     await asyncio.sleep(0.05)
     sender = ReliableSender()
-    handlers = sender.lucky_broadcast(
+    handlers = await sender.lucky_broadcast(
         [("127.0.0.1", p) for p in ports], b"lucky", 2
     )
     assert len(handlers) == 2
@@ -155,11 +155,94 @@ async def test_reliable_lucky_broadcast():
 
 
 @async_test
+async def test_reliable_send_backpressures_never_drops():
+    """A live but SLOW peer must DELAY the sender, not lose messages
+    (reference ``reliable_sender.rs:60-72`` awaits channel capacity): with
+    the peer's socket stalled and the per-peer queue full, ``send`` blocks
+    until the peer drains, and every message is still delivered in order."""
+    import hotstuff_tpu.network.reliable_sender as rs
+
+    port = BASE_PORT + 23
+    orig = rs.QUEUE_CAPACITY
+    rs.QUEUE_CAPACITY = 2
+    payload = bytes(4 * 1024 * 1024)  # exceeds loopback socket buffers
+    try:
+        start_reading = asyncio.Event()
+        received: list[int] = []
+
+        async def stalled_then_drain(reader, writer):
+            await start_reading.wait()
+            while True:
+                frame = await read_frame(reader)
+                received.append(len(frame))
+                write_frame(writer, b"Ack")
+                await writer.drain()
+
+        server = await asyncio.start_server(
+            stalled_then_drain, "127.0.0.1", port
+        )
+        sender = ReliableSender()
+        addr = ("127.0.0.1", port)
+        handlers = []
+        # Fill the peer's TCP buffers and the per-peer queue: some send
+        # must eventually block (back-pressure) instead of dropping.
+        blocked_at = None
+        for i in range(6):
+            task = asyncio.create_task(sender.send(addr, payload))
+            done, _ = await asyncio.wait({task}, timeout=0.5)
+            if not done:
+                blocked_at = i
+                break
+            handlers.append(task.result())
+        assert blocked_at is not None, "sender never back-pressured"
+        # The peer starts draining: the blocked send completes and every
+        # message (including the back-pressured one) is ACKed.
+        start_reading.set()
+        handlers.append(await asyncio.wait_for(task, 30))
+        acks = await asyncio.wait_for(asyncio.gather(*handlers), 60)
+        assert acks == [b"Ack"] * (blocked_at + 1)
+        assert received == [len(payload)] * (blocked_at + 1)
+        sender.shutdown()
+        server.close()
+    finally:
+        rs.QUEUE_CAPACITY = orig
+
+
+@async_test
+async def test_reliable_send_to_dead_peer_does_not_block_forever():
+    """Back-pressure must come from a SLOW live peer, not a dead one: while
+    disconnected the connection task drains its queue into the replay
+    buffer (pruning cancelled messages), so a crashed replica cannot wedge
+    the proposer's broadcast loop (reference ``reliable_sender.rs:160-177``)."""
+    import hotstuff_tpu.network.reliable_sender as rs
+
+    port = BASE_PORT + 24  # nothing ever listens here
+    orig = rs.QUEUE_CAPACITY
+    rs.QUEUE_CAPACITY = 2
+    try:
+        sender = ReliableSender()
+        addr = ("127.0.0.1", port)
+        # 3x the queue capacity: every send must still complete promptly.
+        handlers = []
+        for i in range(6):
+            handlers.append(
+                await asyncio.wait_for(sender.send(addr, b"m%d" % i), 10)
+            )
+        # Cancelling handlers must also free buffered slots for later sends.
+        for h in handlers:
+            h.cancel()
+        await asyncio.wait_for(sender.send(addr, b"after-cancel"), 10)
+        sender.shutdown()
+    finally:
+        rs.QUEUE_CAPACITY = orig
+
+
+@async_test
 async def test_cancelled_handler_skips_replay():
     port = BASE_PORT + 22
     sender = ReliableSender()
-    h1 = sender.send(("127.0.0.1", port), b"cancelled")
-    h2 = sender.send(("127.0.0.1", port), b"kept")
+    h1 = await sender.send(("127.0.0.1", port), b"cancelled")
+    h2 = await sender.send(("127.0.0.1", port), b"kept")
     h1.cancel()
     await asyncio.sleep(0.3)
     payload, ack = await asyncio.wait_for(
