@@ -49,7 +49,8 @@ def probe_device(attempts: int = 4, backoff_s: float = 5.0) -> None:
                 file=sys.stderr,
                 flush=True,
             )
-            time.sleep(backoff_s * (2**attempt))
+            if attempt + 1 < attempts:  # no pointless sleep before raising
+                time.sleep(backoff_s * (2**attempt))
     raise last if last is not None else RuntimeError("unreachable")
 
 
@@ -111,7 +112,7 @@ def bench_cpu(msgs, pubs, sigs, iters: int = 2) -> float:
     """Serial per-signature CPU verification (OpenSSL)."""
     from hotstuff_tpu.crypto import CpuBackend
 
-    backend = CpuBackend()
+    backend = CpuBackend(use_rlc=False)
     backend.verify_batch(msgs, pubs, sigs)  # warm-up
     t0 = time.perf_counter()
     for _ in range(iters):

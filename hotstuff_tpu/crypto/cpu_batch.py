@@ -86,6 +86,8 @@ def verify_batch_rlc_pippenger(msgs, pubs, sigs, rng=None, c: int = 8) -> bool:
     verifies under cofactored semantics. Rejects non-canonical encodings
     host-side exactly like the device pipeline (``ops.verify``).
     """
+    if not len(msgs) == len(pubs) == len(sigs):
+        raise ValueError("batch length mismatch")
     randbits = rng.getrandbits if rng is not None else secrets.randbits
 
     scalars: list[int] = []

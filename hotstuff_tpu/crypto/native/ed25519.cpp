@@ -1,0 +1,394 @@
+// Native Ed25519 batch-verification engine (CPU plane).
+//
+// The reference's CPU hot path is dalek's verify_batch
+// (crypto/src/lib.rs:206-219): fold the batch into one multi-scalar
+// multiplication over a random linear combination and check
+//     8 * sum(scalar_i * P_i) == identity.
+// This engine evaluates exactly that MSM: batched point decompression and
+// a bucketed Pippenger multi-scalar multiplication over the twisted
+// Edwards curve, with GF(2^255-19) in radix-2^51 limbs on uint64
+// (products via unsigned __int128). The Python side does the byte-level
+// strictness checks, SHA-512 challenges and mod-L scalar arithmetic —
+// same split as the device pipeline (ops/verify.py).
+//
+// Single-threaded by design: the box this serves is one core, and the
+// caller (crypto backend) already parallelizes across batches if needed.
+
+#include <cstdint>
+#include <cstring>
+#include <vector>
+
+typedef unsigned __int128 u128;
+
+static const uint64_t MASK51 = ((uint64_t)1 << 51) - 1;
+
+struct fe {
+    uint64_t v[5];
+};
+
+// Per-limb 2p, large enough to keep a + 2p - b non-negative for
+// carried operands (limbs < 2^52).
+static const fe FE_SUB2P = {{0xfffffffffffdaULL, 0xffffffffffffeULL,
+                             0xffffffffffffeULL, 0xffffffffffffeULL,
+                             0xffffffffffffeULL}};
+static const fe FE_D2 = {{0x69b9426b2f159ULL, 0x35050762add7aULL,
+                          0x3cf44c0038052ULL, 0x6738cc7407977ULL,
+                          0x2406d9dc56dffULL}};
+static const fe FE_D = {{0x34dca135978a3ULL, 0x1a8283b156ebdULL,
+                         0x5e7a26001c029ULL, 0x739c663a03cbbULL,
+                         0x52036cee2b6ffULL}};
+static const fe FE_SQRT_M1 = {{0x61b274a0ea0b0ULL, 0xd5a5fc8f189dULL,
+                               0x7ef5e9cbd0c60ULL, 0x78595a6804c9eULL,
+                               0x2b8324804fc1dULL}};
+static const fe FE_ONE = {{1, 0, 0, 0, 0}};
+static const fe FE_ZERO = {{0, 0, 0, 0, 0}};
+
+static inline void fe_add(fe& r, const fe& a, const fe& b) {
+    for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + b.v[i];
+}
+
+static inline void fe_sub(fe& r, const fe& a, const fe& b) {
+    for (int i = 0; i < 5; i++) r.v[i] = a.v[i] + FE_SUB2P.v[i] - b.v[i];
+}
+
+// Weak carry: limbs back under ~2^52 (top folds by 19).
+static inline void fe_carry(fe& r) {
+    uint64_t c;
+    c = r.v[0] >> 51; r.v[0] &= MASK51; r.v[1] += c;
+    c = r.v[1] >> 51; r.v[1] &= MASK51; r.v[2] += c;
+    c = r.v[2] >> 51; r.v[2] &= MASK51; r.v[3] += c;
+    c = r.v[3] >> 51; r.v[3] &= MASK51; r.v[4] += c;
+    c = r.v[4] >> 51; r.v[4] &= MASK51; r.v[0] += 19 * c;
+    c = r.v[0] >> 51; r.v[0] &= MASK51; r.v[1] += c;
+}
+
+static void fe_mul(fe& r, const fe& a, const fe& b) {
+    u128 a0 = a.v[0], a1 = a.v[1], a2 = a.v[2], a3 = a.v[3], a4 = a.v[4];
+    uint64_t b0 = b.v[0], b1 = b.v[1], b2 = b.v[2], b3 = b.v[3], b4 = b.v[4];
+    uint64_t b1_19 = 19 * b1, b2_19 = 19 * b2, b3_19 = 19 * b3, b4_19 = 19 * b4;
+
+    u128 t0 = a0 * b0 + a1 * b4_19 + a2 * b3_19 + a3 * b2_19 + a4 * b1_19;
+    u128 t1 = a0 * b1 + a1 * b0 + a2 * b4_19 + a3 * b3_19 + a4 * b2_19;
+    u128 t2 = a0 * b2 + a1 * b1 + a2 * b0 + a3 * b4_19 + a4 * b3_19;
+    u128 t3 = a0 * b3 + a1 * b2 + a2 * b1 + a3 * b0 + a4 * b4_19;
+    u128 t4 = a0 * b4 + a1 * b3 + a2 * b2 + a3 * b1 + a4 * b0;
+
+    uint64_t c;
+    uint64_t r0 = (uint64_t)t0 & MASK51; c = (uint64_t)(t0 >> 51);
+    t1 += c;
+    uint64_t r1 = (uint64_t)t1 & MASK51; c = (uint64_t)(t1 >> 51);
+    t2 += c;
+    uint64_t r2 = (uint64_t)t2 & MASK51; c = (uint64_t)(t2 >> 51);
+    t3 += c;
+    uint64_t r3 = (uint64_t)t3 & MASK51; c = (uint64_t)(t3 >> 51);
+    t4 += c;
+    uint64_t r4 = (uint64_t)t4 & MASK51; c = (uint64_t)(t4 >> 51);
+    r0 += 19 * c;
+    c = r0 >> 51; r0 &= MASK51; r1 += c;
+
+    r.v[0] = r0; r.v[1] = r1; r.v[2] = r2; r.v[3] = r3; r.v[4] = r4;
+}
+
+static inline void fe_sq(fe& r, const fe& a) { fe_mul(r, a, a); }
+
+// Canonical little-endian bytes of the fully reduced value.
+static void fe_tobytes(uint8_t out[32], const fe& a) {
+    fe t = a;
+    fe_carry(t);
+    fe_carry(t);
+    // Canonicalize: q = floor((t + 19) / 2^255) (the "is t >= p" carry),
+    // then t + 19*q with the bits >= 2^255 masked off subtracts q*p.
+    uint64_t q = (t.v[0] + 19) >> 51;
+    q = (t.v[1] + q) >> 51;
+    q = (t.v[2] + q) >> 51;
+    q = (t.v[3] + q) >> 51;
+    q = (t.v[4] + q) >> 51;
+    t.v[0] += 19 * q;
+    uint64_t c;
+    c = t.v[0] >> 51; t.v[0] &= MASK51; t.v[1] += c;
+    c = t.v[1] >> 51; t.v[1] &= MASK51; t.v[2] += c;
+    c = t.v[2] >> 51; t.v[2] &= MASK51; t.v[3] += c;
+    c = t.v[3] >> 51; t.v[3] &= MASK51; t.v[4] += c;
+    t.v[4] &= MASK51;  // drop bits >= 2^255
+    uint64_t w0 = t.v[0] | (t.v[1] << 51);
+    uint64_t w1 = (t.v[1] >> 13) | (t.v[2] << 38);
+    uint64_t w2 = (t.v[2] >> 26) | (t.v[3] << 25);
+    uint64_t w3 = (t.v[3] >> 39) | (t.v[4] << 12);
+    std::memcpy(out, &w0, 8);
+    std::memcpy(out + 8, &w1, 8);
+    std::memcpy(out + 16, &w2, 8);
+    std::memcpy(out + 24, &w3, 8);
+}
+
+// Little-endian bytes -> limbs. Caller clears/handles the sign bit.
+static void fe_frombytes(fe& r, const uint8_t in[32]) {
+    uint64_t w0, w1, w2, w3;
+    std::memcpy(&w0, in, 8);
+    std::memcpy(&w1, in + 8, 8);
+    std::memcpy(&w2, in + 16, 8);
+    std::memcpy(&w3, in + 24, 8);
+    r.v[0] = w0 & MASK51;
+    r.v[1] = ((w0 >> 51) | (w1 << 13)) & MASK51;
+    r.v[2] = ((w1 >> 38) | (w2 << 26)) & MASK51;
+    r.v[3] = ((w2 >> 25) | (w3 << 39)) & MASK51;
+    r.v[4] = (w3 >> 12) & MASK51;  // drops bit 255 (the sign bit)
+}
+
+static bool fe_iszero(const fe& a) {
+    uint8_t b[32];
+    fe_tobytes(b, a);
+    uint8_t acc = 0;
+    for (int i = 0; i < 32; i++) acc |= b[i];
+    return acc == 0;
+}
+
+static bool fe_eq(const fe& a, const fe& b) {
+    fe d;
+    fe_sub(d, a, b);
+    return fe_iszero(d);
+}
+
+static int fe_parity(const fe& a) {
+    uint8_t b[32];
+    fe_tobytes(b, a);
+    return b[0] & 1;
+}
+
+// z^(2^k) by k squarings.
+static void fe_sqk(fe& r, const fe& z, int k) {
+    fe t = z;
+    for (int i = 0; i < k; i++) fe_sq(t, t);
+    r = t;
+}
+
+// z^(2^252 - 3): the (p-5)/8 exponent of the decompression square root.
+// Addition chain on all-ones exponents: f(a+b) = f(a)^(2^b) * f(b)
+// (same chain as the Pallas kernel's _pow_p58).
+static void fe_pow_p58(fe& r, const fe& z) {
+    fe f1 = z, f2, f4, f5, f10, f20, f40, f80, f160, f240, f250, t;
+    fe_sqk(t, f1, 1); fe_mul(f2, t, f1);
+    fe_sqk(t, f2, 2); fe_mul(f4, t, f2);
+    fe_sqk(t, f4, 1); fe_mul(f5, t, f1);
+    fe_sqk(t, f5, 5); fe_mul(f10, t, f5);
+    fe_sqk(t, f10, 10); fe_mul(f20, t, f10);
+    fe_sqk(t, f20, 20); fe_mul(f40, t, f20);
+    fe_sqk(t, f40, 40); fe_mul(f80, t, f40);
+    fe_sqk(t, f80, 80); fe_mul(f160, t, f80);
+    fe_sqk(t, f160, 80); fe_mul(f240, t, f80);
+    fe_sqk(t, f240, 10); fe_mul(f250, t, f10);
+    fe_sqk(t, f250, 2); fe_mul(r, t, z);
+}
+
+// -- point arithmetic: extended homogeneous coordinates (X, Y, Z, T) -------
+
+struct pt {
+    fe x, y, z, t;
+};
+
+static const pt PT_IDENTITY = {FE_ZERO, FE_ONE, FE_ONE, FE_ZERO};
+
+// Unified addition (add-2008-hwcd-3 for a=-1 twisted Edwards).
+static void pt_add(pt& r, const pt& p, const pt& q) {
+    fe a, b, c, d, e, f, g, h, t1, t2;
+    fe_sub(t1, p.y, p.x);
+    fe_sub(t2, q.y, q.x);
+    fe_mul(a, t1, t2);
+    fe_add(t1, p.y, p.x);
+    fe_add(t2, q.y, q.x);
+    fe_carry(t1);  // sums of carried limbs: keep under mul input bounds
+    fe_carry(t2);
+    fe_mul(b, t1, t2);
+    fe_mul(c, p.t, FE_D2);
+    fe_mul(c, c, q.t);
+    fe_mul(d, p.z, q.z);
+    fe_add(d, d, d);
+    fe_carry(d);
+    fe_sub(e, b, a);
+    fe_sub(f, d, c);
+    fe_add(g, d, c);
+    fe_add(h, b, a);
+    fe_carry(e); fe_carry(f); fe_carry(g); fe_carry(h);
+    fe_mul(r.x, e, f);
+    fe_mul(r.y, g, h);
+    fe_mul(r.z, f, g);
+    fe_mul(r.t, e, h);
+}
+
+// Dedicated doubling (dbl-2008-hwcd).
+static void pt_double(pt& r, const pt& p) {
+    fe a, b, c, e, f, g, h, t1;
+    fe_sq(a, p.x);
+    fe_sq(b, p.y);
+    fe_sq(c, p.z);
+    fe_add(c, c, c);
+    fe_add(h, a, b);
+    fe_add(t1, p.x, p.y);
+    fe_carry(t1);
+    fe_sq(t1, t1);
+    fe_sub(e, h, t1);
+    fe_sub(g, a, b);
+    fe_add(f, c, g);
+    fe_carry(e); fe_carry(f); fe_carry(g); fe_carry(h);
+    fe_mul(r.x, e, f);
+    fe_mul(r.y, g, h);
+    fe_mul(r.z, f, g);
+    fe_mul(r.t, e, h);
+}
+
+static bool pt_is_identity(const pt& p) {
+    if (!fe_iszero(p.x)) return false;
+    // Y == Z != 0: a degenerate (0, 0, 0, *) value — only producible by an
+    // exceptional unified-addition case, never by a valid point — must not
+    // read as the identity.
+    if (fe_iszero(p.y)) return false;
+    return fe_eq(p.y, p.z);
+}
+
+// Decompress a 32-byte encoding. Rejects non-canonical y (y >= p) and
+// off-curve values, matching RFC 8032 / dalek field-element strictness.
+static bool pt_decompress(pt& r, const uint8_t enc[32]) {
+    // Canonicality: the 255-bit y must be < p.
+    uint8_t y_bytes[32];
+    std::memcpy(y_bytes, enc, 32);
+    int sign = y_bytes[31] >> 7;
+    y_bytes[31] &= 0x7f;
+    fe y;
+    fe_frombytes(y, y_bytes);
+    uint8_t canon[32];
+    fe_tobytes(canon, y);
+    if (std::memcmp(canon, y_bytes, 32) != 0) return false;  // y >= p
+
+    // x^2 = (y^2 - 1) / (d y^2 + 1)
+    fe y2, u, v, v3, v7, x, chk, t;
+    fe_sq(y2, y);
+    fe_sub(u, y2, FE_ONE);
+    fe_mul(v, y2, FE_D);
+    fe_add(v, v, FE_ONE);
+    fe_carry(u); fe_carry(v);
+
+    // x = u v^3 (u v^7)^((p-5)/8)
+    fe_sq(t, v);
+    fe_mul(v3, t, v);
+    fe_sq(t, v3);
+    fe_mul(v7, t, v);
+    fe_mul(t, u, v7);
+    fe_pow_p58(t, t);
+    fe_mul(x, u, v3);
+    fe_mul(x, x, t);
+
+    fe_sq(chk, x);
+    fe_mul(chk, chk, v);  // v x^2 in {u, -u} iff a root exists
+    if (!fe_eq(chk, u)) {
+        fe neg_u;
+        fe_sub(neg_u, FE_ZERO, u);
+        if (!fe_eq(chk, neg_u)) return false;
+        fe_mul(x, x, FE_SQRT_M1);
+    }
+    if (fe_iszero(x)) {
+        if (sign) return false;  // -0 is not a valid encoding
+    } else if (fe_parity(x) != sign) {
+        fe_sub(x, FE_ZERO, x);
+        fe_carry(x);
+    }
+    r.x = x;
+    r.y = y;
+    r.z = FE_ONE;
+    fe_mul(r.t, x, y);
+    return true;
+}
+
+extern "C" {
+
+// c-bit window starting at bit offset (byte-unaligned reads via memcpy).
+static inline int scalar_window(const uint8_t* scalar, int bit, int c) {
+    int byte = bit >> 3;
+    if (byte > 24) byte = 24;
+    uint64_t w;
+    std::memcpy(&w, scalar + byte, 8);
+    return (int)((w >> (bit - 8 * byte)) & (((uint64_t)1 << c) - 1));
+}
+
+// encodings: m*32 bytes of compressed points; scalars: m*32 bytes of
+// little-endian scalars (< 2^253, already reduced mod L by the caller).
+// Returns 1 if every point decompresses AND 8 * sum(s_i * P_i) is the
+// identity; 0 if any point is invalid or the sum is nonzero; -1 on bad
+// arguments. ``c`` is the Pippenger window width in bits (the caller
+// picks it by batch size; clamped to [1, 12]). This is the whole device
+// MSM contract on CPU.
+int hs_ed25519_msm_is_identity(const uint8_t* encodings,
+                               const uint8_t* scalars, uint64_t m, int c) {
+    if (encodings == nullptr || scalars == nullptr || m == 0) return -1;
+    if (c < 1) c = 1;
+    if (c > 12) c = 12;
+
+    std::vector<pt> points(m);
+    for (uint64_t i = 0; i < m; i++) {
+        if (!pt_decompress(points[i], encodings + 32 * i)) return 0;
+    }
+
+    // Bucketed Pippenger, c-bit windows, MSB-first. Scalars are < 2^253.
+    const int N_WINDOWS = (253 + c - 1) / c;
+    const int N_BUCKETS = (1 << c) - 1;  // digit 0 skipped
+    std::vector<pt> buckets(N_BUCKETS);
+    std::vector<bool> used(N_BUCKETS);
+
+    pt acc = PT_IDENTITY;
+    bool acc_started = false;
+    for (int w = N_WINDOWS - 1; w >= 0; w--) {
+        if (acc_started) {
+            for (int i = 0; i < c; i++) pt_double(acc, acc);
+        }
+        std::fill(used.begin(), used.end(), false);
+        for (uint64_t i = 0; i < m; i++) {
+            int digit = scalar_window(scalars + 32 * i, w * c, c);
+            if (digit == 0) continue;
+            if (!used[digit - 1]) {
+                buckets[digit - 1] = points[i];
+                used[digit - 1] = true;
+            } else {
+                pt_add(buckets[digit - 1], buckets[digit - 1], points[i]);
+            }
+        }
+        // Sweep: sum_d d*bucket[d] with running suffix sums.
+        pt running = PT_IDENTITY;
+        pt window_sum = PT_IDENTITY;
+        bool any = false;
+        for (int d = N_BUCKETS - 1; d >= 0; d--) {
+            if (used[d]) {
+                pt_add(running, running, buckets[d]);
+                any = true;
+            }
+            if (any) pt_add(window_sum, window_sum, running);
+        }
+        if (any) {
+            if (acc_started) {
+                pt_add(acc, acc, window_sum);
+            } else {
+                acc = window_sum;
+                acc_started = true;
+            }
+        }
+    }
+
+    // Cofactored check: 8 * acc == identity.
+    pt_double(acc, acc);
+    pt_double(acc, acc);
+    pt_double(acc, acc);
+    return pt_is_identity(acc) ? 1 : 0;
+}
+
+// Single-point decompression probe (for tests): returns 1 if the encoding
+// is a valid canonical curve point, else 0; writes the canonical x|y
+// field bytes when out is non-null.
+int hs_ed25519_decompress_check(const uint8_t* enc, uint8_t* out64) {
+    if (enc == nullptr) return -1;
+    pt p;
+    if (!pt_decompress(p, enc)) return 0;
+    if (out64 != nullptr) {
+        fe_tobytes(out64, p.x);
+        fe_tobytes(out64 + 32, p.y);
+    }
+    return 1;
+}
+
+}  // extern "C"

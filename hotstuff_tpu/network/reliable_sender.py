@@ -6,6 +6,21 @@ x2, capped 60 s) and replay every un-ACKed message across reconnects
 (reference ``reliable_sender.rs:131,166,185-247``). Dropping/cancelling the
 handler cancels the message: it is skipped on replay and its ACK discarded
 (reference ``reliable_sender.rs:175,195-197``).
+
+Back-pressure model (deliberately tighter than the reference): ``send``
+awaits capacity, and capacity is measured in LIVE (un-cancelled,
+un-ACKed) messages buffered for the peer. A pump task always moves the
+bounded send queue into the replay buffer — connected, disconnected, or
+mid-connect — pruning cancelled messages as it goes. So:
+
+- a SLOW live peer back-pressures its senders once ``PENDING_CAP`` live
+  messages are outstanding (never dropped — the reference's
+  ``reliable_sender.rs:60-72`` contract);
+- a DEAD or byzantine-stalled peer cannot wedge anyone: callers that give
+  up (the proposer/quorum-waiter after 2f+1 ACKs) cancel their handlers,
+  which frees the buffered slots. The reference wedges in this case (its
+  channel only drains while disconnected); here cancellation always
+  reclaims capacity.
 """
 
 from __future__ import annotations
@@ -20,6 +35,7 @@ from .receiver import read_frame, write_frame
 log = logging.getLogger("network")
 
 QUEUE_CAPACITY = 1_000
+PENDING_CAP = 1_000  # live messages buffered per peer before back-pressure
 RETRY_DELAY_MS = 200
 RETRY_CAP_MS = 60_000
 
@@ -32,41 +48,43 @@ class _Connection:
         self.queue: asyncio.Queue[tuple[bytes, CancelHandler]] = asyncio.Queue(
             QUEUE_CAPACITY
         )
-        # Messages sent but not yet ACKed, FIFO; replayed on reconnect.
+        # Messages awaiting (re)transmission, FIFO; unbounded but pruned of
+        # cancelled entries, and the pump stalls at PENDING_CAP live ones.
         self.pending: deque[tuple[bytes, CancelHandler]] = deque()
+        self.new_work = asyncio.Event()
         self.task = asyncio.create_task(self._keep_alive())
+        self.pump_task = asyncio.create_task(self._pump())
+
+    def _prune(self) -> None:
+        self.pending = deque(
+            (d, h) for d, h in self.pending if not h.cancelled()
+        )
+
+    async def _pump(self) -> None:
+        """Move the send queue into ``pending`` regardless of connection
+        state. Stalls (propagating back-pressure to ``send``) only while
+        PENDING_CAP LIVE messages are buffered; cancellations free slots."""
+        while True:
+            item = await self.queue.get()
+            while len(self.pending) >= PENDING_CAP:
+                self._prune()
+                if len(self.pending) < PENDING_CAP:
+                    break
+                await asyncio.sleep(0.05)
+            self.pending.append(item)
+            self.new_work.set()
 
     async def _keep_alive(self) -> None:
         host, port = self.address
         delay = RETRY_DELAY_MS
         while True:
-            # While disconnected — including DURING the connect attempt,
-            # which can block for the kernel SYN-retry timeout on a
-            # blackholed peer — keep draining the queue into ``pending`` and
-            # prune cancelled messages, so senders back-pressured by ``send``
-            # are never blocked by a DEAD peer, only by a slow live one.
-            # Callers that give up (e.g. the proposer after 2f+1 ACKs)
-            # cancel their handlers, which frees the buffered slots here
-            # (reference ``reliable_sender.rs:160-177`` selects over
-            # connect-retry and channel drain the same way).
-            drain = asyncio.create_task(self._drain_while_disconnected())
             try:
-                while True:
-                    try:
-                        reader, writer = await asyncio.open_connection(host, port)
-                        break
-                    except OSError as e:
-                        log.debug(
-                            "retrying %s:%d in %dms: %s", host, port, delay, e
-                        )
-                        await asyncio.sleep(delay / 1000)
-                        delay = min(delay * 2, RETRY_CAP_MS)
-            finally:
-                drain.cancel()
-                try:
-                    await drain
-                except asyncio.CancelledError:
-                    pass
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError as e:
+                log.debug("retrying %s:%d in %dms: %s", host, port, delay, e)
+                await asyncio.sleep(delay / 1000)
+                delay = min(delay * 2, RETRY_CAP_MS)
+                continue
             delay = RETRY_DELAY_MS
             try:
                 await self._run(reader, writer)
@@ -75,56 +93,55 @@ class _Connection:
             finally:
                 writer.close()
 
-    async def _drain_while_disconnected(self) -> None:
-        drained = 0
-        while True:
-            item = await self.queue.get()
-            self.pending.append(item)
-            drained += 1
-            # Amortized prune: a full deque rebuild per message would be
-            # O(n^2) over a long outage; _run re-prunes on reconnect.
-            if drained % 64 == 0:
-                self.pending = deque(
-                    (d, h) for d, h in self.pending if not h.cancelled()
-                )
-
     async def _run(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
-        # Replay un-ACKed, un-cancelled messages from the previous connection.
-        self.pending = deque(
-            (d, h) for d, h in self.pending if not h.cancelled()
-        )
-        for data, _ in self.pending:
-            write_frame(writer, data)
-        await writer.drain()
+        self._prune()
+        # Sent but not yet ACKed on THIS connection; replayed on reconnect.
+        inflight: deque[tuple[bytes, CancelHandler]] = deque()
 
-        ack_task = asyncio.create_task(read_frame(reader))
-        queue_task = asyncio.create_task(self.queue.get())
-        try:
+        async def write_loop() -> None:
             while True:
-                done, _ = await asyncio.wait(
-                    {ack_task, queue_task}, return_when=asyncio.FIRST_COMPLETED
-                )
-                if queue_task in done:
-                    data, handler = queue_task.result()
-                    queue_task = asyncio.create_task(self.queue.get())
+                while self.pending:
+                    data, handler = self.pending.popleft()
                     if handler.cancelled():
                         continue
-                    self.pending.append((data, handler))
+                    inflight.append((data, handler))
                     write_frame(writer, data)
                     await writer.drain()
-                if ack_task in done:
-                    ack = ack_task.result()  # raises on disconnect
-                    ack_task = asyncio.create_task(read_frame(reader))
-                    # Pair the ACK with the oldest live pending message.
-                    while self.pending:
-                        _, handler = self.pending.popleft()
-                        if handler.cancelled():
-                            continue
-                        handler.set_result(ack)
-                        break
+                self.new_work.clear()
+                await self.new_work.wait()
+
+        async def ack_loop() -> None:
+            while True:
+                ack = await read_frame(reader)  # raises on disconnect
+                # Pair the ACK with the oldest live in-flight message.
+                while inflight:
+                    _, handler = inflight.popleft()
+                    if handler.cancelled():
+                        continue
+                    handler.set_result(ack)
+                    break
+
+        write_task = asyncio.create_task(write_loop())
+        ack_task = asyncio.create_task(ack_loop())
+        try:
+            done, _ = await asyncio.wait(
+                {write_task, ack_task}, return_when=asyncio.FIRST_EXCEPTION
+            )
+            for t in done:
+                t.result()  # re-raise the connection error
         finally:
+            write_task.cancel()
             ack_task.cancel()
-            queue_task.cancel()
+            # Neither child can run again before we await, so reassembling
+            # synchronously here is race-free: un-ACKed messages precede
+            # queued ones on the next connection.
+            self.pending = deque([*inflight, *self.pending])
+            # return_exceptions captures the CHILDREN's cancellation; if
+            # the connection task itself is being cancelled (node
+            # shutdown), the await re-raises OUR CancelledError — it must
+            # propagate, or the task would absorb its own cancellation and
+            # reconnect forever (wedging event-loop teardown).
+            await asyncio.gather(write_task, ack_task, return_exceptions=True)
 
 
 class ReliableSender:
@@ -143,9 +160,11 @@ class ReliableSender:
         """Queue one frame for ``address``; the returned handler resolves
         with the peer's ACK bytes (reference ``reliable_sender.rs:60-72``).
 
-        Awaits queue capacity: when a peer's channel is full the caller is
-        back-pressured, never dropped — "reliable" messages must not vanish
-        under load (the reference's ``send`` likewise awaits the channel)."""
+        Awaits capacity: when PENDING_CAP live messages are already
+        buffered for the peer, the caller is back-pressured, never
+        dropped. Cancelled handlers free capacity immediately, so only a
+        slow LIVE peer (with callers awaiting its ACKs) ever delays
+        anyone."""
         handler: CancelHandler = asyncio.get_running_loop().create_future()
         conn = self._connection(address)
         await conn.queue.put((data, handler))
@@ -167,4 +186,5 @@ class ReliableSender:
     def shutdown(self) -> None:
         for conn in self._connections.values():
             conn.task.cancel()
+            conn.pump_task.cancel()
         self._connections.clear()

@@ -28,12 +28,13 @@ def _ensure_built() -> str:
         not os.path.exists(_LIB)
         or os.path.getmtime(_LIB) < os.path.getmtime(_SRC)
     ):
+        tmp = f"{_LIB}.{os.getpid()}.tmp"  # concurrent builders must not collide
         subprocess.run(
-            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", _LIB + ".tmp"],
+            ["g++", "-O2", "-shared", "-fPIC", "-std=c++17", _SRC, "-o", tmp],
             check=True,
             capture_output=True,
         )
-        os.replace(_LIB + ".tmp", _LIB)
+        os.replace(tmp, _LIB)
     return _LIB
 
 

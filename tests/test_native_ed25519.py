@@ -1,0 +1,140 @@
+"""Property tests for the native C++ Ed25519 batch-verification engine
+against the pure-Python RFC 8032 oracle (``crypto/ed25519_ref``) — the
+same oracle the device kernels are tested against, so all three verifier
+planes (TPU, native CPU, Python) are pinned to one semantics
+(dalek ``verify_batch``, reference ``crypto/src/lib.rs:206-219``)."""
+
+import random
+
+import pytest
+
+from hotstuff_tpu.crypto import CpuBackend, CryptoError
+from hotstuff_tpu.crypto import ed25519_ref as ref
+from hotstuff_tpu.crypto.cpu_batch import verify_batch_rlc_pippenger
+from hotstuff_tpu.crypto.native_ed25519 import (
+    decompress_check,
+    native_available,
+    verify_batch_native,
+)
+
+pytestmark = pytest.mark.skipif(
+    not native_available(), reason="g++ toolchain unavailable"
+)
+
+
+def _batch(n, rng):
+    msgs, pubs, sigs = [], [], []
+    for _ in range(n):
+        seed = rng.randbytes(32)
+        pubs.append(ref.secret_to_public(seed))
+        msgs.append(rng.randbytes(32))
+        sigs.append(ref.sign(seed, msgs[-1]))
+    return msgs, pubs, sigs
+
+
+def test_decompress_agrees_with_oracle_on_random_encodings():
+    rng = random.Random(31)
+    for _ in range(200):
+        enc = rng.randbytes(32)
+        assert decompress_check(enc) == (ref.point_decompress(enc) is not None)
+
+
+def test_decompress_accepts_known_points_rejects_noncanonical():
+    assert decompress_check(ref.point_compress(ref.G))
+    assert decompress_check(ref.point_compress(ref.point_mul(987654321, ref.G)))
+    # y = p is a non-canonical encoding of 0 and must be rejected.
+    assert not decompress_check(ref.P.to_bytes(32, "little"))
+    # -0 (y=1... actually x=0 with sign bit set) must be rejected.
+    assert not decompress_check((1 | 1 << 255).to_bytes(32, "little"))
+
+
+def test_valid_batch_accepts():
+    rng = random.Random(32)
+    msgs, pubs, sigs = _batch(16, rng)
+    assert verify_batch_native(msgs, pubs, sigs, rng=rng)
+
+
+@pytest.mark.parametrize("which", ["sig_s", "sig_r", "msg", "pub"])
+def test_tampered_batch_rejects(which):
+    rng = random.Random(33)
+    msgs, pubs, sigs = _batch(8, rng)
+    i = 3
+    if which == "sig_s":
+        s = int.from_bytes(sigs[i][32:], "little") ^ 2
+        sigs[i] = sigs[i][:32] + s.to_bytes(32, "little")
+    elif which == "sig_r":
+        sigs[i] = ref.point_compress(ref.point_mul(7, ref.G)) + sigs[i][32:]
+    elif which == "msg":
+        msgs[i] = b"\x99" * 32
+    else:
+        pubs[i] = ref.secret_to_public(rng.randbytes(32))
+    assert not verify_batch_native(msgs, pubs, sigs, rng=rng)
+
+
+def test_noncanonical_s_rejected():
+    rng = random.Random(34)
+    msgs, pubs, sigs = _batch(4, rng)
+    s = int.from_bytes(sigs[0][32:], "little") + ref.L
+    sigs[0] = sigs[0][:32] + s.to_bytes(32, "little")
+    assert not verify_batch_native(msgs, pubs, sigs, rng=rng)
+
+
+def test_cofactored_semantics_match_python_batch_verifiers():
+    """A signature with a torsion component in R verifies under the
+    cofactored equation but not the strict one; all three batch verifiers
+    must AGREE (accept), or a committee mixing backends would split."""
+    rng = random.Random(35)
+    msgs, pubs, sigs = _batch(3, rng)
+    t = ref.torsion_generator()
+    r_pt = ref.point_decompress(sigs[0][:32])
+    sigs0_torsioned = ref.point_compress(ref.point_add(r_pt, t)) + sigs[0][32:]
+    # The torsioned R changes the challenge hash, so re-sign around it:
+    # build a fresh signature whose equation holds cofactored-only.
+    # 8(sB) == 8(R' + hA) where R' = R + torsion.
+    msgs2 = [msgs[0]]
+    pubs2 = [pubs[0]]
+    seed = b"\x42" * 32
+    pub = ref.secret_to_public(seed)
+    a, prefix = ref.secret_expand(seed)
+    r = int.from_bytes(ref._sha512(prefix + msgs2[0]), "little") % ref.L
+    big_r = ref.point_mul(r, ref.G)
+    big_r_enc = ref.point_compress(ref.point_add(big_r, t))  # torsioned R
+    h = ref.compute_challenge(big_r_enc, pub, msgs2[0])
+    s = (r + h * a) % ref.L
+    sig = big_r_enc + s.to_bytes(32, "little")
+    pubs2 = [pub]
+    items = (msgs2, pubs2, [sig])
+    assert not ref.verify(pub, msgs2[0], sig, strict=True)
+    assert ref.verify(pub, msgs2[0], sig, strict=False)
+    assert verify_batch_native(*items, rng=random.Random(1))
+    assert verify_batch_rlc_pippenger(*items, rng=random.Random(1))
+    del sigs0_torsioned
+
+
+def test_python_pippenger_agrees_with_native():
+    rng = random.Random(36)
+    msgs, pubs, sigs = _batch(6, rng)
+    assert verify_batch_rlc_pippenger(msgs, pubs, sigs, rng=random.Random(2))
+    assert verify_batch_native(msgs, pubs, sigs, rng=random.Random(2))
+    msgs[2] = b"\x01" * 32
+    assert not verify_batch_rlc_pippenger(msgs, pubs, sigs, rng=random.Random(2))
+    assert not verify_batch_native(msgs, pubs, sigs, rng=random.Random(2))
+
+
+def test_cpu_backend_uses_rlc_and_rejects_bad_batches():
+    rng = random.Random(37)
+    msgs, pubs, sigs = _batch(5, rng)
+    backend = CpuBackend()
+    assert backend._rlc is not None  # native engine picked up
+    backend.verify_batch(msgs, pubs, sigs)  # no raise
+    msgs[1] = b"\x00" * 32
+    with pytest.raises(CryptoError):
+        backend.verify_batch(msgs, pubs, sigs)
+
+
+def test_window_choice_is_sane():
+    from hotstuff_tpu.crypto.native_ed25519 import _pippenger_window
+
+    assert 1 <= _pippenger_window(3) <= 4
+    assert 4 <= _pippenger_window(201) <= 6
+    assert 6 <= _pippenger_window(2687) <= 9

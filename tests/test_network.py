@@ -164,7 +164,9 @@ async def test_reliable_send_backpressures_never_drops():
 
     port = BASE_PORT + 23
     orig = rs.QUEUE_CAPACITY
+    orig_cap = rs.PENDING_CAP
     rs.QUEUE_CAPACITY = 2
+    rs.PENDING_CAP = 2
     payload = bytes(4 * 1024 * 1024)  # exceeds loopback socket buffers
     try:
         start_reading = asyncio.Event()
@@ -187,7 +189,7 @@ async def test_reliable_send_backpressures_never_drops():
         # Fill the peer's TCP buffers and the per-peer queue: some send
         # must eventually block (back-pressure) instead of dropping.
         blocked_at = None
-        for i in range(6):
+        for i in range(10):
             task = asyncio.create_task(sender.send(addr, payload))
             done, _ = await asyncio.wait({task}, timeout=0.5)
             if not done:
@@ -206,6 +208,52 @@ async def test_reliable_send_backpressures_never_drops():
         server.close()
     finally:
         rs.QUEUE_CAPACITY = orig
+        rs.PENDING_CAP = orig_cap
+
+
+@async_test
+async def test_reliable_send_to_stalled_peer_cancellation_frees_capacity():
+    """A byzantine peer that ACCEPTS but never reads must not wedge
+    senders that give up: cancelling handlers reclaims buffer capacity,
+    so a back-pressured send completes once older messages are cancelled
+    (this is where the design is deliberately stricter than the
+    reference, whose channel only drains while disconnected)."""
+    import hotstuff_tpu.network.reliable_sender as rs
+
+    port = BASE_PORT + 25
+    orig_q, orig_cap = rs.QUEUE_CAPACITY, rs.PENDING_CAP
+    rs.QUEUE_CAPACITY = 2
+    rs.PENDING_CAP = 2
+    payload = bytes(4 * 1024 * 1024)
+    try:
+        server = await asyncio.start_server(
+            lambda r, w: asyncio.sleep(3600), "127.0.0.1", port
+        )
+        sender = ReliableSender()
+        addr = ("127.0.0.1", port)
+        granted = []
+        blocked = None
+        for _ in range(10):
+            task = asyncio.create_task(sender.send(addr, payload))
+            done, _ = await asyncio.wait({task}, timeout=0.5)
+            if not done:
+                blocked = task
+                break
+            granted.append(task.result())
+        assert blocked is not None, "stalled peer never back-pressured"
+        # The proposer's pattern: quorum reached elsewhere, give up on the
+        # stalled peer. Capacity must come back and unblock the send.
+        for h in granted:
+            h.cancel()
+        handler = await asyncio.wait_for(blocked, 5)
+        handler.cancel()
+        later = await asyncio.wait_for(sender.send(addr, payload), 5)
+        later.cancel()
+        sender.shutdown()
+        server.close()
+    finally:
+        rs.QUEUE_CAPACITY = orig_q
+        rs.PENDING_CAP = orig_cap
 
 
 @async_test
