@@ -67,6 +67,45 @@ def make_batch(n_sigs: int, seed: int = 2024):
     return msgs, pubs, sigs
 
 
+def bench_device_cached(msgs, pubs, sigs, iters: int = 8, threads: int = 4) -> float:
+    """Steady-state node path: committee keys are device-resident (decompressed
+    once per epoch — committees are static), so each batch pays host prep
+    (hashing, strictness, signed-digit recode), ONE packed transfer, fresh-R
+    decompression and the split signed MSM. Pipelined like ``bench_device``."""
+    from concurrent.futures import ThreadPoolExecutor
+
+    import jax.numpy as jnp
+
+    from hotstuff_tpu.ops.verify import (
+        DevicePointCache,
+        _compiled_cached,
+        prepare_batch_cached,
+        verify_batch_device_cached,
+    )
+
+    probe_device()
+    cache = DevicePointCache()
+    rng = random.Random(2)
+    assert verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=rng)  # warm
+
+    def one_batch(seed: int):
+        r = random.Random(seed)
+        packed, mf, mc = prepare_batch_cached(msgs, pubs, sigs, cache, _rng=r)
+        return _compiled_cached(mf, mc, cache.capacity)(jnp.asarray(packed), cache.array)
+
+    with ThreadPoolExecutor(threads) as ex:
+        warm = [ex.submit(one_batch, 1000 + i) for i in range(threads)]
+        assert np.asarray(jnp.stack([f.result() for f in warm])).all()
+        elapsed = float("inf")
+        for _round in range(3):
+            t0 = time.perf_counter()
+            futures = [ex.submit(one_batch, i) for i in range(iters)]
+            ok = np.asarray(jnp.stack([f.result() for f in futures]))
+            elapsed = min(elapsed, (time.perf_counter() - t0) / iters)
+            assert ok.all()
+    return elapsed
+
+
 def bench_device(msgs, pubs, sigs, iters: int = 8, threads: int = 4) -> float:
     """End-to-end per-batch seconds: full host prep per batch (hashing,
     strictness checks, RLC scalars, byte packing) + one host->device
@@ -163,18 +202,25 @@ def main() -> None:
     # staying comfortably inside typical harness timeouts.
     budget = float(os.environ.get("HOTSTUFF_BENCH_TIMEOUT", "600"))
 
+    def device_both():
+        """(warm_cached_s, cold_s): the committee-cached steady-state path
+        (headline) and the cold full-decompress path (reported alongside)."""
+        warm = bench_device_cached(msgs, pubs, sigs)
+        cold = bench_device(msgs, pubs, sigs)
+        return warm, cold
+
     def device_with_retry():
         # A transient tunnel error (reset connection, lost heartbeat) often
         # clears in seconds; one bounded retry converts those runs from a
         # fallback artifact into a real number. Hangs are still handled by
         # the outer budget timeout.
         try:
-            return bench_device(msgs, pubs, sigs)
+            return device_both()
         except Exception as exc:  # noqa: BLE001
             print(f"device bench attempt 1 failed, retrying: {exc!r}", file=sys.stderr, flush=True)
             time.sleep(10)
             probe_device()
-            return bench_device(msgs, pubs, sigs)
+            return device_both()
 
     with ThreadPoolExecutor(1) as ex:
         fut = ex.submit(device_with_retry)
@@ -199,7 +245,7 @@ def main() -> None:
             os._exit(code)
 
         try:
-            dev_s = fut.result(timeout=budget)
+            dev_s, dev_cold_s = fut.result(timeout=budget)
         except FutTimeout:
             fallback("TPU_UNREACHABLE")
         except KeyboardInterrupt:
@@ -226,6 +272,7 @@ def main() -> None:
                 "vs_batch": round(cpu_batch_us_per_sig / us_per_sig, 3),
                 "cpu_serial_us": round(cpu_us_per_sig, 3),
                 "cpu_batch_us": round(cpu_batch_us_per_sig, 3),
+                "device_cold_us": round(dev_cold_s / n_sigs * 1e6, 3),
             }
         )
     )

@@ -1,0 +1,141 @@
+"""Device-side profile of the QC batch-verify pipeline.
+
+Decomposes the per-batch device time into stages (decompress root /
+kernel A partials / kernel B combine / full pipeline) by timing each
+jitted stage on device-resident inputs as a pipelined stream, which
+cancels the tunnel round-trip latency the same way bench.py does.
+
+Usage: python benchmark/profile_device.py [n_sigs]
+"""
+
+from __future__ import annotations
+
+import random
+import sys
+import time
+
+import numpy as np
+
+from hotstuff_tpu.utils.jaxcache import enable_persistent_cache
+
+enable_persistent_cache()
+
+import jax
+import jax.numpy as jnp
+
+
+def timed(fn, *args, iters: int = 16) -> float:
+    """Median-of-3 rounds of `iters` overlapped calls on device-resident
+    args; returns seconds per call."""
+    outs = [fn(*args) for _ in range(2)]  # warm-up
+    jax.block_until_ready(outs)
+    best = float("inf")
+    for _ in range(3):
+        t0 = time.perf_counter()
+        outs = [fn(*args) for _ in range(iters)]
+        jax.block_until_ready(outs)
+        best = min(best, (time.perf_counter() - t0) / iters)
+    return best
+
+
+def main() -> None:
+    n_sigs = int(sys.argv[1]) if len(sys.argv) > 1 else 1343
+
+    sys.path.insert(0, ".")
+    from bench import make_batch
+
+    from hotstuff_tpu.ops import curve as cv
+    from hotstuff_tpu.ops import field as fe
+    from hotstuff_tpu.ops.verify import _compiled, _kernels, _unpack_device, prepare_batch
+
+    msgs, pubs, sigs = make_batch(n_sigs)
+    packed, m = prepare_batch(msgs, pubs, sigs, _rng=random.Random(7))
+    print(f"n_sigs={n_sigs} lanes={m}")
+    root_fn, msm_fn = _kernels()
+
+    dev_packed = jnp.asarray(packed)
+
+    # Stage jits.
+    @jax.jit
+    def unpack(p):
+        return _unpack_device(p)
+
+    @jax.jit
+    def decomp(p):
+        y, s, d = _unpack_device(p)
+        ok, pts = cv.decompress(y, s, root_fn=root_fn)
+        return ok, pts
+
+    y_limbs, signs, digits = unpack(dev_packed)
+    _, pts = decomp(dev_packed)
+    pts, digits = jax.block_until_ready((pts, digits))
+
+    @jax.jit
+    def sqrt_only(y):
+        yy = fe.square(y)
+        u = fe.sub(yy, fe.fe_from_int(1, yy.shape[:-1]))
+        v = fe.add(fe.mul(yy, jnp.asarray(fe.D_LIMBS)), fe.fe_from_int(1, yy.shape[:-1]))
+        return root_fn(u, v) if root_fn is not None else fe.sqrt_ratio(u, v)[1]
+
+    @jax.jit
+    def msm_only(p, d):
+        return msm_fn(p, d)
+
+    @jax.jit
+    def check_only(a):
+        return cv.is_identity(cv.mul_by_cofactor(a[None, ...]))[0]
+
+    acc = jax.block_until_ready(msm_only(pts, digits))
+
+    full = _compiled(m)
+    stages = {
+        "full_pipeline": (full, (dev_packed,)),
+        "unpack": (unpack, (dev_packed,)),
+        "decompress(all)": (decomp, (dev_packed,)),
+        "sqrt_pow_only": (sqrt_only, (y_limbs,)),
+        "msm": (msm_only, (pts, digits)),
+        "cofactor_check": (check_only, (acc,)),
+    }
+    results = {}
+    for name, (fn, args) in stages.items():
+        s = timed(fn, *args)
+        results[name] = s
+        print(f"{name:18s} {s * 1e3:9.3f} ms/batch  {s / n_sigs * 1e6:7.2f} us/sig")
+
+    # Kernel A vs B split (pallas only).
+    if jax.default_backend() == "tpu":
+        from hotstuff_tpu.ops import pallas_msm as pm
+
+        block = min(pm.DEFAULT_BLOCK, m)
+        if block != m and block % 128 != 0:
+            block = m
+        grid = m // block
+        partials_call = pm._build_partials(m, block)
+        combine_call = pm._build_combine()
+
+        @jax.jit
+        def partials_only(p, d):
+            coords = jnp.moveaxis(p, 0, -1)
+            return partials_call(
+                jnp.asarray(pm.CONSTS_CM), coords[0], coords[1], coords[2], coords[3], d
+            )
+
+        wsums = jax.block_until_ready(partials_only(pts, digits))
+
+        @jax.jit
+        def combine_only(wx, wy, wz, wt):
+            return combine_call(jnp.asarray(pm.CONSTS_LM), wx, wy, wz, wt)
+
+        for name, (fn, args) in {
+            "kernelA_partials": (partials_only, (pts, digits)),
+            "kernelB_combine": (combine_only, tuple(wsums)),
+        }.items():
+            s = timed(fn, *args)
+            print(f"{name:18s} {s * 1e3:9.3f} ms/batch  {s / n_sigs * 1e6:7.2f} us/sig")
+        print(f"(pallas block={block} grid={grid})")
+
+
+if __name__ == "__main__":
+    main()
+
+

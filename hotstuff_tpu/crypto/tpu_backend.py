@@ -4,9 +4,17 @@ Routes ``Signature.verify_batch`` to the device random-linear-combination
 verifier in ``hotstuff_tpu.ops`` — the north-star offload of the QC hot path
 (reference ``crypto/src/lib.rs:206-219``). Acceptance semantics: cofactored
 (dalek ``verify_batch``-equivalent), identical to ``CpuBackend``.
+
+With more than one visible device the backend automatically shards the MSM
+lanes over a ``jax.sharding.Mesh`` and combines per-device partial sums
+over ICI (``parallel.mesh``) — the BASELINE config-5 path (4096-validator
+vote sets across a v5e pod slice). Override with ``sharded=True/False`` or
+``HOTSTUFF_TPU_SHARDED=1/0``.
 """
 
 from __future__ import annotations
+
+import os
 
 from . import BackendUnavailable, CryptoError
 
@@ -14,7 +22,7 @@ from . import BackendUnavailable, CryptoError
 class TpuBackend:
     name = "tpu"
 
-    def __init__(self) -> None:
+    def __init__(self, sharded: bool | None = None) -> None:
         try:
             from hotstuff_tpu.ops import verify as _ops_verify  # noqa: F401
         except ImportError as e:  # pragma: no cover
@@ -23,6 +31,33 @@ class TpuBackend:
                 "(jax device kernels); not available: %s" % e
             ) from e
         self._ops = _ops_verify
+        self._mesh = None
+        if sharded is None:
+            env = os.environ.get("HOTSTUFF_TPU_SHARDED", "auto")
+            sharded = None if env == "auto" else env not in ("0", "false", "no")
+        if sharded is not False:
+            try:
+                import jax
+
+                n_dev = jax.device_count()
+            except Exception:  # pragma: no cover - device init failure
+                n_dev = 1
+            if n_dev > 1:
+                from hotstuff_tpu.parallel import mesh as _pmesh
+
+                self._pmesh = _pmesh
+                self._mesh = _pmesh.make_mesh()
+        # Committee point cache: validator keys decompress once and stay
+        # device-resident (committees are static per epoch); per-QC work is
+        # then R-decompress + signed-digit MSM only. HOTSTUFF_TPU_CACHE=0
+        # reverts to the full-decompress path. The sharded mesh path has its
+        # own lane layout and does not consult the cache, so skip building
+        # it there.
+        self._cache = None
+        if self._mesh is None and os.environ.get(
+            "HOTSTUFF_TPU_CACHE", "1"
+        ) not in ("0", "false", "no"):
+            self._cache = _ops_verify.DevicePointCache()
 
     def verify_batch(self, msgs, pubs, sigs) -> None:
         if not len(msgs) == len(pubs) == len(sigs):
@@ -30,7 +65,20 @@ class TpuBackend:
         if not msgs:
             return
         try:
-            ok = self._ops.verify_batch_device(msgs, pubs, sigs)
+            if self._mesh is not None:
+                ok = self._pmesh.verify_batch_device_sharded(
+                    self._mesh, msgs, pubs, sigs
+                )
+            elif self._cache is not None:
+                try:
+                    ok = self._ops.verify_batch_device_cached(
+                        msgs, pubs, sigs, self._cache
+                    )
+                except self._ops.CacheFull:
+                    self._cache = None  # 64k distinct signers: stop caching
+                    ok = self._ops.verify_batch_device(msgs, pubs, sigs)
+            else:
+                ok = self._ops.verify_batch_device(msgs, pubs, sigs)
         except Exception as e:
             # Device/runtime failure: the batch was NOT judged.
             raise BackendUnavailable(f"device verification failed: {e!r}") from e

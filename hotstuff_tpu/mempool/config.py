@@ -26,6 +26,12 @@ class Parameters:
     sync_retry_nodes: int = 3  # number of nodes
     batch_size: int = 500_000  # bytes
     max_batch_delay: int = 100  # ms
+    # Route concurrent batch digests (SHA-512/32) through the device kernel
+    # (``ops.sha512``) instead of per-batch host hashing — the BASELINE
+    # config-3 regime (committee-scale digest throughput). Off by default:
+    # at small committees a lone batch is latency-bound and host hashing
+    # wins.
+    device_batch_digests: bool = False
 
     def log(self) -> None:
         # These log entries are picked up by the benchmark log parser

@@ -133,7 +133,12 @@ class Mempool:
         )
         # Own batches: hash, store, digest to consensus.
         self.tasks.append(
-            Processor.spawn(self.store, tx_own_processor, self.tx_consensus)
+            Processor.spawn(
+                self.store,
+                tx_own_processor,
+                self.tx_consensus,
+                device_digests=self.parameters.device_batch_digests,
+            )
         )
 
         # Peer messages: batches + batch requests.
@@ -147,7 +152,12 @@ class Mempool:
         )
         # Peer batches: hash, store, digest to consensus.
         self.tasks.append(
-            Processor.spawn(self.store, tx_peer_processor, self.tx_consensus)
+            Processor.spawn(
+                self.store,
+                tx_peer_processor,
+                self.tx_consensus,
+                device_digests=self.parameters.device_batch_digests,
+            )
         )
         self.tasks.append(Helper.spawn(self.committee, self.store, tx_helper))
 

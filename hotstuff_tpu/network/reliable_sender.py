@@ -49,8 +49,14 @@ class _Connection:
             QUEUE_CAPACITY
         )
         # Messages awaiting (re)transmission, FIFO; unbounded but pruned of
-        # cancelled entries, and the pump stalls at PENDING_CAP live ones.
+        # cancelled entries on reconnect. The LIVE count (un-cancelled,
+        # un-ACKed, whether still pending or in flight on the current
+        # socket) is tracked by ``self.live`` via handler done-callbacks,
+        # and the pump stalls at PENDING_CAP live ones.
         self.pending: deque[tuple[bytes, CancelHandler]] = deque()
+        self.live = 0
+        self.capacity = asyncio.Event()
+        self.capacity.set()
         self.new_work = asyncio.Event()
         self.task = asyncio.create_task(self._keep_alive())
         self.pump_task = asyncio.create_task(self._pump())
@@ -60,17 +66,33 @@ class _Connection:
             (d, h) for d, h in self.pending if not h.cancelled()
         )
 
+    def _on_handler_done(self, _fut) -> None:
+        # ACKed or cancelled: either way the message stops counting against
+        # the peer's live budget; wake the pump if it was stalled.
+        self.live -= 1
+        if self.live < PENDING_CAP:
+            self.capacity.set()
+
     async def _pump(self) -> None:
         """Move the send queue into ``pending`` regardless of connection
         state. Stalls (propagating back-pressure to ``send``) only while
-        PENDING_CAP LIVE messages are buffered; cancellations free slots."""
+        PENDING_CAP LIVE messages are outstanding — pending OR written but
+        un-ACKed — so a connected peer that reads frames without ACKing
+        them is bounded exactly like a disconnected one. Completion
+        callbacks (ACK or cancel) free slots and wake the pump; no
+        polling."""
         while True:
             item = await self.queue.get()
-            while len(self.pending) >= PENDING_CAP:
-                self._prune()
-                if len(self.pending) < PENDING_CAP:
+            while self.live >= PENDING_CAP:
+                self.capacity.clear()
+                if self.live < PENDING_CAP:  # completion raced the clear
                     break
-                await asyncio.sleep(0.05)
+                await self.capacity.wait()
+            data, handler = item
+            if handler.cancelled():
+                continue  # dead before it ever counted
+            self.live += 1
+            handler.add_done_callback(self._on_handler_done)
             self.pending.append(item)
             self.new_work.set()
 

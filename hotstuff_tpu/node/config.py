@@ -176,6 +176,7 @@ class Parameters:
                     sync_retry_nodes=int(m.get("sync_retry_nodes", 3)),
                     batch_size=int(m.get("batch_size", 500_000)),
                     max_batch_delay=int(m.get("max_batch_delay", 100)),
+                    device_batch_digests=bool(m.get("device_batch_digests", False)),
                 ),
             )
         except (OSError, ValueError) as e:
@@ -193,6 +194,7 @@ class Parameters:
                 "sync_retry_nodes": self.mempool.sync_retry_nodes,
                 "batch_size": self.mempool.batch_size,
                 "max_batch_delay": self.mempool.max_batch_delay,
+                "device_batch_digests": self.mempool.device_batch_digests,
             },
         }
         with open(path, "w") as f:

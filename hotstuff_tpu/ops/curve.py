@@ -158,6 +158,63 @@ def scalars_to_digits(scalars: list[int]) -> np.ndarray:
     return out
 
 
+def scalars_to_signed_digits(scalars: list[int], n_windows: int) -> np.ndarray:
+    """Scalars -> int32[n_windows, m] SIGNED radix-16 digits in [-8, 8],
+    MSB-first. sum_w d_w * 16^(n_windows-1-w) == s exactly; requires
+    s < 16^n_windows / 2 so the final carry cannot overflow (mod-L scalars
+    fit 64 windows, 128-bit RLC coefficients fit 33).
+
+    Signed digits halve the device table (9 entries, 7 additions to build)
+    and the per-window select (9 compares + a conditional negate — point
+    negation is 2 cheap field negations), the same recoding trick dalek's
+    radix-16 scalar_mul uses on CPU.
+    """
+    m = len(scalars)
+    nibs = np.zeros((n_windows, m), dtype=np.int32)  # LSB-first here
+    for j, s in enumerate(scalars):
+        assert 2 * s < 1 << (4 * n_windows), "scalar too wide for window count"
+        for w in range(n_windows):
+            nibs[w, j] = (s >> (4 * w)) & 0xF
+    carry = np.zeros(m, dtype=np.int32)
+    for w in range(n_windows):
+        d = nibs[w] + carry
+        carry = (d > 8).astype(np.int32)
+        nibs[w] = d - 16 * carry
+    assert not carry.any(), "top-window carry (scalar too wide)"
+    return nibs[::-1]  # MSB-first
+
+
+def signed_digits_from_bytes(scalar_bytes: np.ndarray, n_windows: int) -> np.ndarray:
+    """Vectorized ``scalars_to_signed_digits``: uint8[m, 32] little-endian
+    scalars -> int32[n_windows, m] signed digits, MSB-first. The carry
+    sweep is sequential over the n_windows windows but vectorized over all
+    m lanes (the host hot path at 4096-lane batches)."""
+    sb = np.asarray(scalar_bytes, dtype=np.uint8)
+    m = sb.shape[0]
+    lo = (sb & 0xF).astype(np.int32)
+    hi = (sb >> 4).astype(np.int32)
+    nibs = np.empty((64, m), dtype=np.int32)  # LSB-first
+    nibs[0::2] = lo.T
+    nibs[1::2] = hi.T
+    assert not nibs[n_windows:].any(), "scalar too wide for window count"
+    nibs = nibs[:n_windows]
+    carry = np.zeros(m, dtype=np.int32)
+    for w in range(n_windows):
+        d = nibs[w] + carry
+        carry = (d > 8).astype(np.int32)
+        nibs[w] = d - 16 * carry
+    assert not carry.any(), "top-window carry (scalar too wide)"
+    return nibs[::-1]
+
+
+def point_neg(p: jnp.ndarray) -> jnp.ndarray:
+    """-(X : Y : Z : T) = (-X : Y : Z : -T)."""
+    return jnp.stack(
+        [fe.neg(p[..., 0, :]), p[..., 1, :], p[..., 2, :], fe.neg(p[..., 3, :])],
+        axis=-2,
+    )
+
+
 def _build_table(points: jnp.ndarray) -> jnp.ndarray:
     """[m, 4, 20] -> [m, TABLE, 4, 20] with table[:, d] = d * P."""
     m = points.shape[0]
@@ -195,6 +252,36 @@ def msm(points: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
 
     # Init carry derived from the inputs so its sharding variance matches
     # inside shard_map bodies.
+    init = points[0] * 0 + jnp.asarray(IDENTITY)
+    acc, _ = lax.scan(body, init, digits)
+    return acc
+
+
+def _build_table_signed(points: jnp.ndarray) -> jnp.ndarray:
+    """[m, 4, 20] -> [m, 9, 4, 20] with table[:, d] = d * P (d in 0..8)."""
+    entries = [identity((points.shape[0],)), points]
+    for _ in range(7):
+        entries.append(point_add(entries[-1], points))
+    return jnp.stack(entries, axis=1)
+
+
+def msm_signed(points: jnp.ndarray, digits: jnp.ndarray) -> jnp.ndarray:
+    """``msm`` over SIGNED radix-16 digits (from
+    ``scalars_to_signed_digits``): 9-entry tables + conditional negation.
+
+    ``digits``: [n_windows, m] in [-8, 8], MSB-first; n_windows is free
+    (33 for 128-bit RLC coefficients, 64 for mod-L scalars).
+    """
+    table = _build_table_signed(points)  # [m, 9, 4, 20]
+
+    def body(acc, digit_row):
+        acc = point_double(point_double(point_double(point_double(acc))))
+        mag = jnp.abs(digit_row)[:, None, None, None]  # [m, 1, 1, 1]
+        sel = jnp.take_along_axis(table, mag, axis=1)[:, 0]  # [m, 4, 20]
+        sel = point_select(digit_row >= 0, sel, point_neg(sel))
+        acc = point_add(acc, _tree_reduce(sel))
+        return acc, None
+
     init = points[0] * 0 + jnp.asarray(IDENTITY)
     acc, _ = lax.scan(body, init, digits)
     return acc

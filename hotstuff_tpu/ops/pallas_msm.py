@@ -271,6 +271,75 @@ def sqrt_pow(u: jnp.ndarray, v: jnp.ndarray, block: int | None = None):
     return _build_sqrt(m, block)(u, v)
 
 
+# -- Kernel A (signed): per-block window partial sums, 9-entry table --------
+
+TABLE_SIGNED = 9  # multiples 0..8; negative digits negate the selection
+
+
+def _neg_fe(x, two_p):
+    """-x mod p on [20, L] loose limbs (2p - x, carried)."""
+    return _carry(two_p - x)
+
+
+def _make_partials_kernel_signed(n_windows: int):
+    def kernel(consts, px, py, pz, pt, digits_ref, wx, wy, wz, wt, tx, ty, tz, tt):
+        block = px.shape[-1]
+        two_p, d2 = consts[0], consts[1]
+        # 9-entry table: T[0] = identity, T[d] = T[d-1] + P (7 adds vs 14
+        # for the unsigned 16-entry table).
+        zero = jnp.zeros((NLIMB, block), dtype=jnp.int32)
+        one = _one_limbs(block)
+        tx[0], ty[0], tz[0], tt[0] = zero, one, one, zero
+        tx[1], ty[1], tz[1], tt[1] = px[:], py[:], pz[:], pt[:]
+        for d in range(2, TABLE_SIGNED):
+            nx, ny, nz, nt = _padd(
+                (tx[d - 1], ty[d - 1], tz[d - 1], tt[d - 1]),
+                (px[:], py[:], pz[:], pt[:]),
+                two_p,
+                d2,
+            )
+            tx[d], ty[d], tz[d], tt[d] = nx, ny, nz, nt
+
+        def window(w, _):
+            dg = digits_ref[w]  # [block], signed in [-8, 8]
+            mag = jnp.abs(dg)
+            selx = jnp.zeros((NLIMB, block), dtype=jnp.int32)
+            sely = jnp.zeros((NLIMB, block), dtype=jnp.int32)
+            selz = jnp.zeros((NLIMB, block), dtype=jnp.int32)
+            selt = jnp.zeros((NLIMB, block), dtype=jnp.int32)
+            for d in range(TABLE_SIGNED):
+                m = (mag == d)[None, :]
+                selx = jnp.where(m, tx[d], selx)
+                sely = jnp.where(m, ty[d], sely)
+                selz = jnp.where(m, tz[d], selz)
+                selt = jnp.where(m, tt[d], selt)
+            negm = (dg < 0)[None, :]
+            selx = jnp.where(negm, _neg_fe(selx, two_p), selx)
+            selt = jnp.where(negm, _neg_fe(selt, two_p), selt)
+            cur = (selx, sely, selz, selt)
+            half = block // 2
+            while half >= 1:
+                cur = _padd(
+                    tuple(c[:, :half] for c in cur),
+                    tuple(c[:, half : 2 * half] for c in cur),
+                    two_p,
+                    d2,
+                )
+                half //= 2
+            cx, cy, cz, ct = cur  # [20, 1]
+            wx[0, w], wy[0, w], wz[0, w], wt[0, w] = (
+                cx[:, 0],
+                cy[:, 0],
+                cz[:, 0],
+                ct[:, 0],
+            )
+            return 0
+
+        jax.lax.fori_loop(0, n_windows, window, 0)
+
+    return kernel
+
+
 # -- Kernel A: per-block window partial sums --------------------------------
 
 
@@ -325,39 +394,46 @@ def _partials_kernel(
 # -- Kernel B: combine block partials + Horner over windows ----------------
 
 
-def _combine_kernel(consts, wx, wy, wz, wt, ox, oy, oz, ot, sx, sy, sz, st):
-    nblocks = wx.shape[0]
-    two_p_lm, d2_lm = consts[0], consts[1]  # [1, 20] limbs-minor
-    # Sum the per-block window partials in limbs-minor layout ([64, 20]).
-    cur = (wx[0], wy[0], wz[0], wt[0])
-    for g in range(1, nblocks):
-        cur = _padd_lm(cur, (wx[g], wy[g], wz[g], wt[g]), two_p_lm, d2_lm)
-    # Stage the combined window sums in scratch: dynamic indexing is only
-    # lowerable on refs, not on computed values.
-    sx[:], sy[:], sz[:], st[:] = cur
+def _make_combine_kernel(n_windows: int):
+    def kernel(consts, wx, wy, wz, wt, ox, oy, oz, ot, sx, sy, sz, st):
+        nblocks = wx.shape[0]
+        two_p_lm, d2_lm = consts[0], consts[1]  # [1, 20] limbs-minor
+        # Sum the per-block window partials in limbs-minor layout
+        # ([n_windows, 20]).
+        cur = (wx[0], wy[0], wz[0], wt[0])
+        for g in range(1, nblocks):
+            cur = _padd_lm(cur, (wx[g], wy[g], wz[g], wt[g]), two_p_lm, d2_lm)
+        # Stage the combined window sums in scratch: dynamic indexing is only
+        # lowerable on refs, not on computed values.
+        sx[:], sy[:], sz[:], st[:] = cur
 
-    # Horner over windows, MSB-first: S = 16*S + W[w]; states are [1, 20].
-    def step(w, s):
-        for _ in range(4):
-            s = _pdouble_lm(s, two_p_lm)
-        ww = (
-            sx[pl.ds(w, 1)],
-            sy[pl.ds(w, 1)],
-            sz[pl.ds(w, 1)],
-            st[pl.ds(w, 1)],
-        )
-        return _padd_lm(s, ww, two_p_lm, d2_lm)
+        # Horner over windows, MSB-first: S = 16*S + W[w]; states are [1, 20].
+        def step(w, s):
+            for _ in range(4):
+                s = _pdouble_lm(s, two_p_lm)
+            ww = (
+                sx[pl.ds(w, 1)],
+                sy[pl.ds(w, 1)],
+                sz[pl.ds(w, 1)],
+                st[pl.ds(w, 1)],
+            )
+            return _padd_lm(s, ww, two_p_lm, d2_lm)
 
-    s0 = (sx[0:1], sy[0:1], sz[0:1], st[0:1])  # [1, 20]
-    rx, ry, rz, rt = jax.lax.fori_loop(1, N_WINDOWS, step, s0)
-    ox[:], oy[:], oz[:], ot[:] = rx, ry, rz, rt
+        s0 = (sx[0:1], sy[0:1], sz[0:1], st[0:1])  # [1, 20]
+        rx, ry, rz, rt = jax.lax.fori_loop(1, n_windows, step, s0)
+        ox[:], oy[:], oz[:], ot[:] = rx, ry, rz, rt
+
+    return kernel
+
+
+_combine_kernel = _make_combine_kernel(N_WINDOWS)
 
 
 # -- host wrapper -----------------------------------------------------------
 
 
 @functools.lru_cache(maxsize=16)
-def _build(m: int, block: int):
+def _build_partials(m: int, block: int):
     grid = m // block
     const_spec = pl.BlockSpec((2, NLIMB, 1), lambda b: (0, 0, 0))
     limb_spec = pl.BlockSpec((NLIMB, block), lambda b: (0, b))
@@ -365,7 +441,7 @@ def _build(m: int, block: int):
     wsum_spec = pl.BlockSpec((1, N_WINDOWS, NLIMB), lambda b: (b, 0, 0))
     wsum_shape = jax.ShapeDtypeStruct((grid, N_WINDOWS, NLIMB), jnp.int32)
 
-    partials = pl.pallas_call(
+    return pl.pallas_call(
         _partials_kernel,
         grid=(grid,),
         in_specs=[const_spec] + [limb_spec] * 4 + [digit_spec],
@@ -374,11 +450,20 @@ def _build(m: int, block: int):
         scratch_shapes=[pltpu.VMEM((TABLE, NLIMB, block), jnp.int32)] * 4,
     )
 
-    combine = pl.pallas_call(
+
+@functools.lru_cache(maxsize=16)
+def _build_combine():
+    return pl.pallas_call(
         _combine_kernel,
         out_shape=[jax.ShapeDtypeStruct((1, NLIMB), jnp.int32)] * 4,
         scratch_shapes=[pltpu.VMEM((N_WINDOWS, NLIMB), jnp.int32)] * 4,
     )
+
+
+@functools.lru_cache(maxsize=16)
+def _build(m: int, block: int):
+    partials = _build_partials(m, block)
+    combine = _build_combine()
 
     @jax.jit
     def run(points, digits):
@@ -408,3 +493,59 @@ def msm(points: jnp.ndarray, digits: jnp.ndarray, block: int | None = None):
         block = m
     assert m % block == 0
     return _build(m, block)(points, digits)
+
+
+# -- signed-digit variant ---------------------------------------------------
+
+DEFAULT_BLOCK_SIGNED = 1024  # 9-entry table: ~3 MB VMEM at 1024 lanes
+
+
+@functools.lru_cache(maxsize=32)
+def _build_signed(m: int, block: int, n_windows: int):
+    grid = m // block
+    const_spec = pl.BlockSpec((2, NLIMB, 1), lambda b: (0, 0, 0))
+    limb_spec = pl.BlockSpec((NLIMB, block), lambda b: (0, b))
+    digit_spec = pl.BlockSpec((n_windows, block), lambda b: (0, b))
+    wsum_spec = pl.BlockSpec((1, n_windows, NLIMB), lambda b: (b, 0, 0))
+    wsum_shape = jax.ShapeDtypeStruct((grid, n_windows, NLIMB), jnp.int32)
+
+    partials = pl.pallas_call(
+        _make_partials_kernel_signed(n_windows),
+        grid=(grid,),
+        in_specs=[const_spec] + [limb_spec] * 4 + [digit_spec],
+        out_specs=[wsum_spec] * 4,
+        out_shape=[wsum_shape] * 4,
+        scratch_shapes=[pltpu.VMEM((TABLE_SIGNED, NLIMB, block), jnp.int32)] * 4,
+    )
+
+    combine = pl.pallas_call(
+        _make_combine_kernel(n_windows),
+        out_shape=[jax.ShapeDtypeStruct((1, NLIMB), jnp.int32)] * 4,
+        scratch_shapes=[pltpu.VMEM((n_windows, NLIMB), jnp.int32)] * 4,
+    )
+
+    @jax.jit
+    def run(points, digits):
+        coords = jnp.moveaxis(points, 0, -1)  # [4, 20, m]
+        wx, wy, wz, wt = partials(
+            jnp.asarray(CONSTS_CM), coords[0], coords[1], coords[2], coords[3], digits
+        )
+        ox, oy, oz, ot = combine(jnp.asarray(CONSTS_LM), wx, wy, wz, wt)
+        return jnp.stack([ox[0], oy[0], oz[0], ot[0]])
+
+    return run
+
+
+def msm_signed(points: jnp.ndarray, digits: jnp.ndarray, block: int | None = None):
+    """Pallas MSM over SIGNED radix-16 digits (``curve.msm_signed``
+    semantics): 9-entry tables + in-kernel conditional negation, window
+    count taken from ``digits.shape[0]`` (33 for RLC lanes, 64 for mod-L).
+    """
+    m = points.shape[0]
+    n_windows = digits.shape[0]
+    if block is None:
+        block = min(DEFAULT_BLOCK_SIGNED, m)
+    if block != m and block % 128 != 0:
+        block = m
+    assert m % block == 0
+    return _build_signed(m, block, n_windows)(points, digits)

@@ -100,6 +100,34 @@ async def test_processor_hashes_stores_forwards():
 
 
 @async_test
+async def test_processor_device_digests_drain_queue():
+    """device_digests=True: concurrently-pending batches are hashed in one
+    device call (bit-exact vs host SHA-512/32) and every digest/store write
+    still lands (BASELINE config 3 wiring)."""
+    store = Store()
+    rx_batch, tx_digest = asyncio.Queue(), asyncio.Queue()
+    batches = [encode_batch([tx(size=20 + i)]) for i in range(5)]
+    for b in batches:
+        rx_batch.put_nowait(b)
+    Processor.spawn(store, rx_batch, tx_digest, device_digests=True)
+    got = [await asyncio.wait_for(tx_digest.get(), 10) for _ in batches]
+    assert got == [sha512_digest(b) for b in batches]
+    for b, d in zip(batches, got):
+        assert await store.read(d.data) == b
+
+
+@async_test
+async def test_processor_device_digests_single_batch_host_path():
+    store = Store()
+    rx_batch, tx_digest = asyncio.Queue(), asyncio.Queue()
+    Processor.spawn(store, rx_batch, tx_digest, device_digests=True)
+    batch = encode_batch([tx(size=33)])
+    await rx_batch.put(batch)
+    digest = await asyncio.wait_for(tx_digest.get(), 5)
+    assert digest == sha512_digest(batch)
+
+
+@async_test
 async def test_synchronizer_emits_batch_request():
     committee = mempool_committee(BASE + 30)
     (name, _), (target, _) = keys()[0], keys()[1]

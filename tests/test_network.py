@@ -212,6 +212,65 @@ async def test_reliable_send_backpressures_never_drops():
 
 
 @async_test
+async def test_reliable_backpressure_counts_unacked_inflight():
+    """A CONNECTED peer that reads frames but withholds ACKs must still
+    back-pressure the sender at PENDING_CAP live messages: capacity is
+    measured in un-ACKed messages, not just not-yet-written ones."""
+    import hotstuff_tpu.network.reliable_sender as rs
+
+    port = BASE_PORT + 27
+    orig_q, orig_cap = rs.QUEUE_CAPACITY, rs.PENDING_CAP
+    rs.QUEUE_CAPACITY = 100  # queue must NOT be the binding constraint
+    rs.PENDING_CAP = 3
+    try:
+        release = asyncio.Event()
+        frames_before_release = 0
+        unacked = 0
+        peer_writer: list = []
+
+        async def read_but_withhold_acks(reader, writer):
+            nonlocal frames_before_release, unacked
+            peer_writer.append(writer)
+            while True:
+                await read_frame(reader)  # consume eagerly: no TCP pressure
+                if release.is_set():
+                    write_frame(writer, b"Ack")
+                    await writer.drain()
+                else:
+                    frames_before_release += 1
+                    unacked += 1
+
+        server = await asyncio.start_server(
+            read_but_withhold_acks, "127.0.0.1", port
+        )
+        sender = ReliableSender()
+        addr = ("127.0.0.1", port)
+        tasks = [
+            asyncio.create_task(sender.send(addr, b"m%d" % i)) for i in range(8)
+        ]
+        await asyncio.sleep(1.0)
+        conn = sender._connections[addr]
+        assert conn.live <= rs.PENDING_CAP, "live cap exceeded"
+        assert frames_before_release <= rs.PENDING_CAP, (
+            "peer received more than CAP un-ACKed frames"
+        )
+        # The peer flushes the withheld ACKs and ACKs everything further:
+        # the stalled sends unblock and all eight messages resolve.
+        release.set()
+        for _ in range(unacked):
+            write_frame(peer_writer[-1], b"Ack")
+        await peer_writer[-1].drain()
+        handlers = await asyncio.wait_for(asyncio.gather(*tasks), 30)
+        acks = await asyncio.wait_for(asyncio.gather(*handlers), 30)
+        assert acks == [b"Ack"] * 8
+        sender.shutdown()
+        server.close()
+    finally:
+        rs.QUEUE_CAPACITY = orig_q
+        rs.PENDING_CAP = orig_cap
+
+
+@async_test
 async def test_reliable_send_to_stalled_peer_cancellation_frees_capacity():
     """A byzantine peer that ACCEPTS but never reads must not wedge
     senders that give up: cancelling handlers reclaims buffer capacity,

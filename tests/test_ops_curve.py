@@ -6,6 +6,8 @@ import random
 import numpy as np
 import pytest
 
+pytestmark = pytest.mark.device
+
 jnp = pytest.importorskip("jax.numpy")
 
 from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402

@@ -1,0 +1,175 @@
+"""Remote-harness smoke test: drives the full RemoteBench flow
+(install → update → config → run → logs) against a subprocess-stubbed SSH
+transport (reference flow: ``benchmark/benchmark/remote.py:58-235``).
+
+Each fake host is a sandbox directory; ``scp`` copies land there and the
+``nohup ... &`` boot commands synthesize the benchmark logs a real run
+would leave behind, so the download+parse leg exercises the real LogParser
+contract end to end.
+"""
+
+import json
+import os
+import re
+import subprocess
+
+import pytest
+
+from benchmark.remote import RemoteBench
+from benchmark.settings import Settings
+
+HOSTS = ["10.0.0.1", "10.0.0.2", "10.0.0.3", "10.0.0.4"]
+
+
+def _settings():
+    return Settings(
+        testbed="smoke",
+        key_name="k",
+        key_path="/dev/null",
+        consensus_port=8000,
+        mempool_port=7000,
+        front_port=6000,
+        repo_name="repo",
+        repo_url="https://example.invalid/repo.git",
+        branch="main",
+        instance_type="m5d.8xlarge",
+        aws_regions=["us-east-1"],
+    )
+
+
+NODE_LOG = """\
+[2026-07-29T10:00:00.000Z INFO consensus] Timeout delay set to 1000 ms
+[2026-07-29T10:00:00.000Z INFO consensus] Sync retry delay set to 10000 ms
+[2026-07-29T10:00:00.000Z INFO mempool] Garbage collection depth set to 50 rounds
+[2026-07-29T10:00:00.000Z INFO mempool] Sync retry delay set to 5000 ms
+[2026-07-29T10:00:00.000Z INFO mempool] Sync retry nodes set to 3 nodes
+[2026-07-29T10:00:00.000Z INFO mempool] Batch size set to 15000 B
+[2026-07-29T10:00:00.000Z INFO mempool] Max batch delay set to 10 ms
+[2026-07-29T10:00:01.000Z INFO mempool] Batch abcd= contains sample tx 0
+[2026-07-29T10:00:01.000Z INFO mempool] Batch abcd= contains 15000 B
+[2026-07-29T10:00:01.100Z INFO consensus] Created B1 -> abcd=
+[2026-07-29T10:00:01.140Z INFO consensus] Committed B1 -> abcd=
+"""
+
+CLIENT_LOG = """\
+[2026-07-29T10:00:00.000Z INFO client] Transactions size: 512 B
+[2026-07-29T10:00:00.000Z INFO client] Transactions rate: 250 tx/s
+[2026-07-29T10:00:00.500Z INFO client] Start sending transactions
+[2026-07-29T10:00:00.900Z INFO client] Sending sample transaction 0
+"""
+
+
+class FakeSSHFabric:
+    """Routes ``ssh``/``scp`` argv to per-host sandbox directories."""
+
+    def __init__(self, root: str) -> None:
+        self.root = root
+        self.commands: list[tuple[str, str]] = []  # (host, command)
+
+    def host_dir(self, host: str) -> str:
+        d = os.path.join(self.root, host)
+        os.makedirs(d, exist_ok=True)
+        return d
+
+    def _resolve(self, host: str, path: str) -> str:
+        path = path.replace("~/", "").lstrip("/")
+        full = os.path.join(self.host_dir(host), path)
+        os.makedirs(os.path.dirname(full), exist_ok=True)
+        return full
+
+    def __call__(self, argv, **kwargs):
+        if argv[0] == "ssh":
+            target, command = argv[-2], argv[-1]
+            host = target.split("@", 1)[1]
+            self.commands.append((host, command))
+            # Boot commands leave behind the logs a real run would produce.
+            if "node.client" in command:
+                with open(self._resolve(host, "bench/client.log"), "w") as f:
+                    f.write(CLIENT_LOG)
+            elif "hotstuff_tpu.node run" in command:
+                with open(self._resolve(host, "bench/node.log"), "w") as f:
+                    f.write(NODE_LOG)
+            if "mkdir -p bench" in command:
+                os.makedirs(
+                    os.path.join(self.host_dir(host), "bench"), exist_ok=True
+                )
+            return subprocess.CompletedProcess(argv, 0, stdout="", stderr="")
+        if argv[0] == "scp":
+            src, dst = argv[-2], argv[-1]
+
+            def local(spec: str) -> str:
+                if spec.startswith("ubuntu@"):
+                    host, path = spec[len("ubuntu@") :].split(":", 1)
+                    return self._resolve(host, path)
+                return spec
+
+            with open(local(src), "rb") as s, open(local(dst), "wb") as d:
+                d.write(s.read())
+            return subprocess.CompletedProcess(argv, 0, stdout=b"", stderr=b"")
+        raise AssertionError(f"unexpected subprocess call: {argv}")
+
+
+@pytest.fixture()
+def fabric(tmp_path, monkeypatch):
+    fake = FakeSSHFabric(str(tmp_path / "hosts"))
+    monkeypatch.setattr("benchmark.remote.subprocess.run", fake)
+    monkeypatch.setattr("benchmark.remote.time.sleep", lambda *_: None)
+    monkeypatch.chdir(tmp_path)
+    return fake
+
+
+def test_install_and_update_reach_every_host(fabric):
+    bench = RemoteBench(_settings(), HOSTS)
+    bench.install()
+    bench.update()
+    for host in HOSTS:
+        cmds = [c for h, c in fabric.commands if h == host]
+        assert any("git clone" in c for c in cmds), host
+        assert any("git pull" in c for c in cmds), host
+
+
+def test_config_uploads_committee_keys_params(fabric, tmp_path):
+    bench = RemoteBench(_settings(), HOSTS)
+    bench.config(work_dir=str(tmp_path / "wd"))
+    key_names = set()
+    for host in HOSTS:
+        bench_dir = os.path.join(fabric.host_dir(host), "bench")
+        with open(os.path.join(bench_dir, "committee.json")) as f:
+            committee = json.load(f)
+        assert len(committee["consensus"]["authorities"]) == len(HOSTS)
+        # every consensus address points at its host on the consensus port
+        addrs = {
+            a["address"]
+            for a in committee["consensus"]["authorities"].values()
+        }
+        assert addrs == {f"{h}:8000" for h in HOSTS}
+        with open(os.path.join(bench_dir, "parameters.json")) as f:
+            params = json.load(f)
+        assert "consensus" in params and "mempool" in params
+        with open(os.path.join(bench_dir, "key.json")) as f:
+            key_names.add(json.load(f)["name"])
+    assert len(key_names) == len(HOSTS)  # each host got its own secret
+
+
+def test_run_boots_clients_then_nodes_and_parses_logs(fabric):
+    bench = RemoteBench(_settings(), HOSTS)
+    bench.config(work_dir="wd")
+    parser = bench.run(rate=1_000, tx_size=512, duration=10, timeout_delay=1_000)
+    summary = parser.result()
+    assert "Committee size: 4 nodes" in summary
+    assert "Input rate: 1,000 tx/s" in summary
+    assert re.search(r"End-to-end latency: \d+ ms", summary)
+    # boot ordering per reference remote.py:177-219: all clients before nodes
+    boots = [c for _, c in fabric.commands if "nohup" in c]
+    first_node = next(i for i, c in enumerate(boots) if "node run" in c)
+    assert all("node.client" in c for c in boots[:first_node])
+    assert len(boots) == 2 * len(HOSTS)
+
+
+def test_run_with_faults_skips_last_hosts(fabric):
+    bench = RemoteBench(_settings(), HOSTS)
+    bench.config(work_dir="wd")
+    parser = bench.run(rate=900, tx_size=512, duration=5, faults=1, timeout_delay=1_000)
+    boots = [(h, c) for h, c in fabric.commands if "nohup" in c]
+    assert all(h != HOSTS[-1] for h, _ in boots)  # faulty host never booted
+    assert "Faults: 1 nodes" in parser.result()

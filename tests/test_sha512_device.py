@@ -7,6 +7,8 @@ import pytest
 
 pytest.importorskip("jax")
 
+pytestmark = pytest.mark.device
+
 from hotstuff_tpu.ops.sha512 import sha512_32_batch, sha512_batch  # noqa: E402
 
 rng = random.Random(99)

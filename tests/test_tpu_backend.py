@@ -7,6 +7,8 @@ import pytest
 
 pytest.importorskip("jax")
 
+pytestmark = pytest.mark.device
+
 from hotstuff_tpu.crypto import (  # noqa: E402
     CryptoError,
     Digest,
@@ -99,3 +101,29 @@ def test_tpu_backend_qc_verify():
     committee = consensus_committee(14000)
     blocks = chain(2)
     blocks[1].verify(committee)  # embedded QC batch-verifies on device
+
+
+def test_tpu_backend_auto_shards_on_multidevice():
+    """On a multi-device platform (the conftest's virtual 8-CPU mesh) the
+    backend must select the lane-sharded mesh verifier automatically
+    (BASELINE config 5 wiring) — and both polarities must flow through it."""
+    import jax
+
+    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
+
+    backend = TpuBackend()
+    assert jax.device_count() > 1
+    assert backend._mesh is not None, "multi-device must auto-select the mesh"
+
+    msgs, pubs, sigs = make_batch(5, seed=21)
+    backend.verify_batch(msgs, pubs, sigs)  # must not raise
+    bad = bytearray(sigs[2])
+    bad[7] ^= 0x20
+    with pytest.raises(CryptoError):
+        backend.verify_batch(msgs, pubs, [*sigs[:2], bytes(bad), *sigs[3:]])
+
+
+def test_tpu_backend_sharded_override_off():
+    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
+
+    assert TpuBackend(sharded=False)._mesh is None

@@ -1,0 +1,245 @@
+"""v2 device verification: signed-digit MSM + committee point cache.
+
+Bit-exactness of the signed recode/MSM against the pure-Python oracle and
+acceptance-set parity of the cached path with the v1 path (cofactored
+semantics, reference ``crypto/src/lib.rs:206-219``).
+"""
+
+import random
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+pytestmark = pytest.mark.device
+
+from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402
+from hotstuff_tpu.ops import curve as cv  # noqa: E402
+from hotstuff_tpu.ops import verify as v  # noqa: E402
+
+
+def make_batch(n=3, seed=5):
+    rng = random.Random(seed)
+    msgs, pubs, sigs = [], [], []
+    for _ in range(n):
+        seed_bytes = rng.randbytes(32)
+        pubs.append(ref.secret_to_public(seed_bytes))
+        msgs.append(rng.randbytes(32))
+        sigs.append(ref.sign(seed_bytes, msgs[-1]))
+    return msgs, pubs, sigs
+
+
+# -- signed digit recode ----------------------------------------------------
+
+
+def test_signed_digits_reconstruct_scalar():
+    rng = random.Random(1)
+    scalars = [rng.getrandbits(253) for _ in range(9)] + [0, 1, ref.L - 1]
+    digits = cv.scalars_to_signed_digits(scalars, 64)
+    assert digits.min() >= -8 and digits.max() <= 8
+    for j, s in enumerate(scalars):
+        val = 0
+        for w in range(64):
+            val = val * 16 + int(digits[w, j])
+        assert val == s
+
+
+def test_signed_digits_narrow_windows():
+    rng = random.Random(2)
+    scalars = [rng.getrandbits(128) | (1 << 127) for _ in range(7)]
+    digits = cv.scalars_to_signed_digits(scalars, 33)
+    for j, s in enumerate(scalars):
+        val = 0
+        for w in range(33):
+            val = val * 16 + int(digits[w, j])
+        assert val == s
+
+
+def test_signed_digits_from_bytes_matches_int_version():
+    rng = random.Random(3)
+    scalars = [rng.getrandbits(252) for _ in range(11)]
+    sb = np.frombuffer(
+        b"".join(s.to_bytes(32, "little") for s in scalars), dtype=np.uint8
+    ).reshape(-1, 32)
+    a = cv.signed_digits_from_bytes(sb, 64)
+    b = cv.scalars_to_signed_digits(scalars, 64)
+    assert (a == b).all()
+
+
+# -- signed MSM vs oracle ---------------------------------------------------
+
+
+def _random_points(rng, m):
+    pts, ints = [], []
+    for _ in range(m):
+        k = rng.getrandbits(250) % ref.L
+        p_int = ref.point_mul(k, ref.G)
+        ints.append(p_int)
+        enc = ref.point_compress(p_int)
+        import numpy as _np
+
+        from hotstuff_tpu.ops import field as fe
+
+        y = fe.fe_from_bytes(
+            _np.frombuffer(bytes([b & (0x7F if i == 31 else 0xFF) for i, b in enumerate(enc)]), dtype=_np.uint8)[None]
+        )[0]
+        sign = enc[31] >> 7
+        ok, pt = cv.decompress(np.asarray(y)[None], np.asarray([sign]))
+        assert bool(ok[0])
+        pts.append(np.asarray(pt[0]))
+    return np.stack(pts), ints
+
+
+def test_msm_signed_matches_oracle():
+    rng = random.Random(7)
+    m = 4
+    pts, p_ints = _random_points(rng, m)
+    scalars = [rng.getrandbits(250) % ref.L for _ in range(m)]
+    digits = cv.scalars_to_signed_digits(scalars, 64)
+    acc = cv.msm_signed(np.asarray(pts), np.asarray(digits))
+    expected = None
+    for s, p in zip(scalars, p_ints):
+        term = ref.point_mul(s, p)
+        expected = term if expected is None else ref.point_add(expected, term)
+    got = cv.to_affine_bytes(acc)
+    assert got == ref.point_compress(expected)
+
+
+def test_msm_signed_narrow_windows_matches_oracle():
+    rng = random.Random(8)
+    m = 4
+    pts, p_ints = _random_points(rng, m)
+    scalars = [rng.getrandbits(128) | (1 << 127) for _ in range(m)]
+    digits = cv.scalars_to_signed_digits(scalars, 33)
+    acc = cv.msm_signed(np.asarray(pts), np.asarray(digits))
+    expected = None
+    for s, p in zip(scalars, p_ints):
+        term = ref.point_mul(s, p)
+        expected = term if expected is None else ref.point_add(expected, term)
+    assert cv.to_affine_bytes(acc) == ref.point_compress(expected)
+
+
+# -- cached verification path ----------------------------------------------
+
+
+def test_cached_path_accepts_valid_batch():
+    cache = v.DevicePointCache(capacity=64)
+    msgs, pubs, sigs = make_batch(4, seed=11)
+    assert v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(1))
+    # warm second call (all keys cached now)
+    assert v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(2))
+    assert len(cache._rows) == 5  # 4 keys + base point
+
+
+def test_cached_path_rejects_tampered_signature():
+    cache = v.DevicePointCache(capacity=64)
+    msgs, pubs, sigs = make_batch(4, seed=12)
+    assert v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(1))
+    bad = bytearray(sigs[2])
+    bad[1] ^= 4
+    sigs[2] = bytes(bad)
+    assert not v.verify_batch_device_cached(
+        msgs, pubs, sigs, cache, _rng=random.Random(1)
+    )
+
+
+def test_cached_path_rejects_tampered_message():
+    cache = v.DevicePointCache(capacity=64)
+    msgs, pubs, sigs = make_batch(3, seed=13)
+    msgs[0] = b"\x55" * 32
+    assert not v.verify_batch_device_cached(
+        msgs, pubs, sigs, cache, _rng=random.Random(1)
+    )
+
+
+def test_cached_path_rejects_noncanonical_s():
+    cache = v.DevicePointCache(capacity=64)
+    msgs, pubs, sigs = make_batch(1, seed=14)
+    s = int.from_bytes(sigs[0][32:], "little") + ref.L
+    sigs[0] = sigs[0][:32] + s.to_bytes(32, "little")
+    assert not v.verify_batch_device_cached(
+        msgs, pubs, sigs, cache, _rng=random.Random(1)
+    )
+
+
+def test_cached_path_rejects_invalid_pubkey():
+    cache = v.DevicePointCache(capacity=64)
+    msgs, pubs, sigs = make_batch(2, seed=15)
+    # y >= p: non-canonical encoding must be rejected host-side
+    bad_pub = (v.P + 1).to_bytes(32, "little")
+    assert not v.verify_batch_device_cached(
+        msgs, [pubs[0], bad_pub], sigs, cache, _rng=random.Random(1)
+    )
+    # and remembered as invalid (fast path)
+    assert not cache.ensure([bad_pub])
+
+
+def test_cached_path_accepts_torsioned_r_like_v1():
+    """Cofactored parity: torsioned R accepted, matching v1/CPU."""
+    rng = random.Random(16)
+    seed = rng.randbytes(32)
+    a, _ = ref.secret_expand(seed)
+    pub = ref.point_compress(ref.point_mul(a, ref.G))
+    msg = rng.randbytes(32)
+    t8 = ref.torsion_generator()
+    r = rng.getrandbits(250) % ref.L
+    r_enc = ref.point_compress(ref.point_add(ref.point_mul(r, ref.G), t8))
+    h = ref.compute_challenge(r_enc, pub, msg)
+    s = (r + h * a) % ref.L
+    sig = r_enc + int.to_bytes(s, 32, "little")
+    cache = v.DevicePointCache(capacity=64)
+    assert v.verify_batch_device_cached([msg], [pub], [sig], cache, _rng=random.Random(1))
+
+
+def test_failed_insert_never_aliases_registered_rows():
+    """Regression: an off-curve (canonical y, no sqrt) encoding inserted
+    alongside honest keys must not burn a row in a way that lets a LATER
+    insert overwrite a registered key's device point."""
+    cache = v.DevicePointCache(capacity=64)
+    msgs, pubs, sigs = make_batch(2, seed=19)
+    # Find a canonical y that decompresses to nothing (fails on device,
+    # passes host canonicality).
+    off_curve = None
+    for c in range(2, 200):
+        enc = c.to_bytes(32, "little")
+        if not cache.ensure([enc]):
+            off_curve = enc
+            break
+        cache = v.DevicePointCache(capacity=64)  # reset if it was a point
+    assert off_curve is not None
+    cache = v.DevicePointCache(capacity=64)
+    assert not cache.ensure([off_curve, pubs[0]])  # mixed insert fails overall
+    row_a = cache.lookup(pubs[0])
+    assert row_a is not None  # the honest key still registered
+    # A later insert must take a FRESH row, not pubs[0]'s.
+    assert cache.ensure([pubs[1]])
+    assert cache.lookup(pubs[1]) != row_a
+    # and batches signed by pubs[0] still verify against the right point
+    assert v.verify_batch_device_cached(
+        msgs[:1], pubs[:1], sigs[:1], cache, _rng=random.Random(1)
+    )
+
+
+def test_cache_grows_beyond_initial_capacity():
+    cache = v.DevicePointCache(capacity=16)
+    msgs, pubs, sigs = make_batch(20, seed=17)
+    assert v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(1))
+    assert cache.capacity >= 21
+    assert len(cache._rows) == 21
+
+
+def test_cached_matches_v1_acceptance_on_mixed_batches():
+    """Same accept/reject verdicts as the v1 full-decompress path across a
+    spread of mutations."""
+    rng = random.Random(18)
+    for trial in range(4):
+        cache = v.DevicePointCache(capacity=64)
+        msgs, pubs, sigs = make_batch(3, seed=100 + trial)
+        if trial % 2:
+            bad = bytearray(sigs[trial % 3])
+            bad[trial % 32] ^= 1 << (trial % 8)
+            sigs[trial % 3] = bytes(bad)
+        v1 = v.verify_batch_device(msgs, pubs, sigs, _rng=random.Random(42))
+        v2 = v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(42))
+        assert v1 == v2, f"trial {trial}: v1={v1} v2={v2}"
