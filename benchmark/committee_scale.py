@@ -1,18 +1,28 @@
 """Committee-scale consensus benchmark (BASELINE.json configs 2-4).
 
-Boots an N-validator committee of full consensus engines IN ONE PROCESS
-(mempool channels sunk, like the reference's `node deploy` testbed) with
-``batch_vote_verification`` on, and measures round rate and QC sizes under
-the selected crypto backend:
+Two modes:
+
+``--mode protocol`` (default) boots an N-validator committee of full
+consensus engines IN ONE PROCESS over real localhost TCP (mempool channels
+sunk, like the reference's `node deploy` testbed) with
+``batch_vote_verification`` on, and measures round rate under the selected
+crypto backend. Socket count scales as N^2, so this mode tops out around
+N=100 on one host.
+
+``--mode crypto`` measures the per-round *certificate verification* load at
+committees where the protocol cannot be materialized on one box (N=400,
+N=1000 — BASELINE configs 3-4): each round verifies one proposal the way a
+validator does (block signature + embedded 2f+1-vote QC batch verification,
+``consensus/messages.py`` — the same code the node runs), and with
+``--tc-heavy`` additionally verifies a (2f+1)-signature TimeoutCertificate
+per round (the f=333 view-change regime; reference ``messages.rs:283-320``).
 
     python -m benchmark.committee_scale --nodes 20 --rounds 20
-    HOTSTUFF_CRYPTO_BACKEND=tpu python -m benchmark.committee_scale --nodes 20
+    HOTSTUFF_CRYPTO_BACKEND=tpu python -m benchmark.committee_scale \
+        --nodes 1000 --mode crypto --tc-heavy --output results
 
-At committee scale the per-round cost is dominated by QC verification
-(every validator batch-verifies the 2f+1 signatures embedded in each
-proposal): the point of the TPU backend. All N validators share one event
-loop and one CPU core here, so absolute round rates are a lower bound; the
-relevant comparison is cpu-backend vs tpu-backend at the same N.
+Results are appended to ``results/committee-<mode>[-tc]-<backend>-<N>.txt``
+when ``--output`` is given (the committed corpus under ``results/``).
 """
 
 from __future__ import annotations
@@ -81,26 +91,91 @@ async def run_committee(n: int, rounds_target: int, base_port: int, timeout_dela
     return elapsed / rounds_target
 
 
+def run_crypto_rounds(n: int, rounds: int, tc_heavy: bool) -> float:
+    """Per-round certificate-verification time at committee size n: one
+    proposal verification (block sig + QC batch over 2f+1 votes) and, with
+    ``tc_heavy``, one (2f+1)-vote TC verification — the exact
+    ``Block.verify``/``TC.verify`` code paths a validator runs per round."""
+    import struct
+
+    from hotstuff_tpu.consensus import Authority, Committee
+    from hotstuff_tpu.consensus.messages import QC, TC, Block
+    from hotstuff_tpu.crypto import Signature, generate_keypair, sha512_digest
+
+    keys = [generate_keypair() for _ in range(n)]
+    committee = Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", 0))
+            for pk, _ in keys
+        }
+    )
+    f = (n - 1) // 3
+    quorum = 2 * f + 1
+
+    # Genesis-parented block signed by the round-2 leader, with a real QC
+    # over round 1 and (optionally) a TC for the view change into round 2.
+    genesis = Block.genesis()
+    qc = QC(hash=genesis.digest(), round=1, votes=[])
+    qc.votes = [
+        (pk, Signature.new(qc.digest(), sk)) for pk, sk in keys[:quorum]
+    ]
+
+    tc = None
+    if tc_heavy:
+        u64 = struct.Struct("<Q")
+        tc_votes = [
+            (pk, Signature.new(sha512_digest(u64.pack(2), u64.pack(1)), sk), 1)
+            for pk, sk in keys[:quorum]
+        ]
+        tc = TC(round=2, votes=tc_votes)
+
+    author_pk, author_sk = keys[0]
+    block = Block.new_from_key(
+        qc=qc, tc=tc, author=author_pk, round_=2, payload=[], secret=author_sk
+    )
+
+    block.verify(committee)  # warm-up (device compile / native lib load)
+    t0 = time.perf_counter()
+    for _ in range(rounds):
+        block.verify(committee)
+    return (time.perf_counter() - t0) / rounds
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=20)
     p.add_argument("--rounds", type=int, default=20)
     p.add_argument("--base-port", type=int, default=17000)
     p.add_argument("--timeout", type=int, default=30_000)
+    p.add_argument("--mode", choices=["protocol", "crypto"], default="protocol")
+    p.add_argument("--tc-heavy", action="store_true")
+    p.add_argument("--output", help="directory to append the result file to")
     args = p.parse_args()
 
     from hotstuff_tpu.crypto import get_backend
 
     backend = get_backend().name
     f = (args.nodes - 1) // 3
-    per_round = asyncio.run(
-        run_committee(args.nodes, args.rounds, args.base_port, args.timeout)
-    )
-    print(
-        f"committee={args.nodes} (f={f}, QC size {2 * f + 1}) "
-        f"backend={backend} batch_votes=on: "
+    if args.mode == "protocol":
+        per_round = asyncio.run(
+            run_committee(args.nodes, args.rounds, args.base_port, args.timeout)
+        )
+    else:
+        per_round = run_crypto_rounds(args.nodes, args.rounds, args.tc_heavy)
+    line = (
+        f"committee={args.nodes} (f={f}, QC size {2 * f + 1}) mode={args.mode}"
+        f"{' tc-heavy' if args.tc_heavy else ''} backend={backend}: "
         f"{per_round * 1e3:.1f} ms/round ({1 / per_round:.2f} rounds/s)"
     )
+    print(line)
+    if args.output:
+        os.makedirs(args.output, exist_ok=True)
+        tag = f"{args.mode}{'-tc' if args.tc_heavy else ''}"
+        path = os.path.join(
+            args.output, f"committee-{tag}-{backend}-{args.nodes}.txt"
+        )
+        with open(path, "a") as out:
+            out.write(line + "\n")
 
 
 if __name__ == "__main__":

@@ -76,7 +76,9 @@ class Consensus:
         parameters.log()
 
         tx_consensus: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
-        tx_loopback: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        # Loopback blocks ride the SAME merged queue as network messages
+        # (tagged ("loopback", block)) — the core consumes one queue.
+        tx_loopback: asyncio.Queue = tx_consensus
         tx_proposer: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
         tx_helper: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
 

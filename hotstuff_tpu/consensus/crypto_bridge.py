@@ -55,8 +55,24 @@ def _executor() -> ThreadPoolExecutor:
     return _EXECUTOR
 
 
+def _backend_blocks() -> bool:
+    """True when the active crypto backend can block the loop for long
+    (device round trips / super-batching windows). CPU verifications are
+    sub-millisecond native calls: dispatching them to the worker pool costs
+    more (executor queue hop + thread wake + GIL churn, straight on the
+    vote path) than running them inline — on a single-core host it is pure
+    loss, since the loop would only be idle-waiting anyway."""
+    from hotstuff_tpu.crypto import get_backend
+
+    return "tpu" in getattr(get_backend(), "name", "")
+
+
 async def verify_off_loop(verify_fn, *args):
-    """Run a blocking verification callable off the event loop; re-raises
-    its exception (ConsensusError/CryptoError) in the awaiting task."""
+    """Run a blocking verification callable without head-of-line-blocking
+    the event loop; re-raises its exception (ConsensusError/CryptoError) in
+    the awaiting task. Device-backed verifications go to the worker pool;
+    CPU ones run inline (see ``_backend_blocks``)."""
+    if not _backend_blocks():
+        return verify_fn(*args)
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(_executor(), lambda: verify_fn(*args))

@@ -54,7 +54,7 @@ class MempoolDriver:
     async def _waiter(self, missing: list[Digest], block: Block) -> None:
         await asyncio.gather(*[self.store.notify_read(d.data) for d in missing])
         self._pending.pop(block.digest(), None)
-        await self.tx_loopback.put(block)
+        await self.tx_loopback.put(("loopback", block))
 
     async def cleanup(self, round_: Round) -> None:
         await self.tx_mempool.put(MempoolCleanup(round_))

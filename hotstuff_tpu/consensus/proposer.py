@@ -99,7 +99,7 @@ class Proposer:
             (name, await self.network.send(addr, serialized))
             for name, addr in names_addresses
         ]
-        await self.tx_loopback.put(block)
+        await self.tx_loopback.put(("loopback", block))
 
         # Control system: wait for 2f+1 stake to ACK before proposing again.
         from hotstuff_tpu.utils.quorum import cancel_remaining, wait_for_ack_quorum

@@ -52,7 +52,7 @@ class Synchronizer:
         await self.store.notify_read(wait_on.data)
         self._pending.discard(deliver.digest())
         self._requests.pop(deliver.parent(), None)
-        await self.tx_loopback.put(deliver)
+        await self.tx_loopback.put(("loopback", deliver))
 
     async def _run(self) -> None:
         get_block = asyncio.create_task(self._inner.get())

@@ -275,15 +275,29 @@ def sqrt_pow(u: jnp.ndarray, v: jnp.ndarray, block: int | None = None):
 
 TABLE_SIGNED = 9  # multiples 0..8; negative digits negate the selection
 
+# Per-window tree-reduction stops at this lane width inside the window
+# loop; the tails of ALL windows are then reduced together in a few
+# full-width passes. Rationale: tree levels narrower than a vreg are
+# instruction-issue-bound (a padd costs the same instruction count at
+# width 8 as at width 128), and the window loop used to pay log2(block)
+# narrow levels x 64 windows; batching the tails pays log2(TAIL) levels
+# ONCE at n_windows*TAIL width (~40% of the old MSM time, per the round-1
+# roadmap analysis).
+TAIL = 16
+
 
 def _neg_fe(x, two_p):
     """-x mod p on [20, L] loose limbs (2p - x, carried)."""
     return _carry(two_p - x)
 
 
-def _make_partials_kernel_signed(n_windows: int):
-    def kernel(consts, px, py, pz, pt, digits_ref, wx, wy, wz, wt, tx, ty, tz, tt):
-        block = px.shape[-1]
+def _make_partials_kernel_signed(n_windows: int, block: int):
+    tail = min(TAIL, block)
+
+    def kernel(
+        consts, px, py, pz, pt, digits_ref, wx, wy, wz, wt,
+        tx, ty, tz, tt, bx, by, bz, bt,
+    ):
         two_p, d2 = consts[0], consts[1]
         # 9-entry table: T[0] = identity, T[d] = T[d-1] + P (7 adds vs 14
         # for the unsigned 16-entry table).
@@ -318,7 +332,7 @@ def _make_partials_kernel_signed(n_windows: int):
             selt = jnp.where(negm, _neg_fe(selt, two_p), selt)
             cur = (selx, sely, selz, selt)
             half = block // 2
-            while half >= 1:
+            while half >= tail:  # stop at TAIL lanes; batch the rest
                 cur = _padd(
                     tuple(c[:, :half] for c in cur),
                     tuple(c[:, half : 2 * half] for c in cur),
@@ -326,16 +340,41 @@ def _make_partials_kernel_signed(n_windows: int):
                     d2,
                 )
                 half //= 2
-            cx, cy, cz, ct = cur  # [20, 1]
-            wx[0, w], wy[0, w], wz[0, w], wt[0, w] = (
-                cx[:, 0],
-                cy[:, 0],
-                cz[:, 0],
-                ct[:, 0],
+            # Stage this window's TAIL-wide partial at lanes
+            # [w*tail, (w+1)*tail) of the cross-window buffer.
+            sl = pl.ds(w * tail, tail)
+            cx, cy, cz, ct = cur
+            bx[:, sl], by[:, sl], bz[:, sl], bt[:, sl] = (
+                cx[:, :tail],
+                cy[:, :tail],
+                cz[:, :tail],
+                ct[:, :tail],
             )
             return 0
 
         jax.lax.fori_loop(0, n_windows, window, 0)
+
+        # Cross-window tail reduction: log2(tail) passes over the FULL
+        # [20, n_windows*tail] buffer. Lane w*tail+j pairs with lane
+        # w*tail+j+half via a lane-axis rotate; only lanes with
+        # j + half < tail are meaningful, and the final window sums land
+        # at lanes w*tail. 2D shapes only (Mosaic-safe).
+        cur = (bx[:, :], by[:, :], bz[:, :], bt[:, :])
+        half = tail // 2
+        while half >= 1:
+            shifted = tuple(
+                jnp.concatenate([c[:, half:], c[:, :half]], axis=1) for c in cur
+            )
+            cur = _padd(cur, shifted, two_p, d2)
+            half //= 2
+        rx, ry, rz, rt = cur
+        for w in range(n_windows):
+            wx[0, w], wy[0, w], wz[0, w], wt[0, w] = (
+                rx[:, w * tail],
+                ry[:, w * tail],
+                rz[:, w * tail],
+                rt[:, w * tail],
+            )
 
     return kernel
 
@@ -509,13 +548,15 @@ def _build_signed(m: int, block: int, n_windows: int):
     wsum_spec = pl.BlockSpec((1, n_windows, NLIMB), lambda b: (b, 0, 0))
     wsum_shape = jax.ShapeDtypeStruct((grid, n_windows, NLIMB), jnp.int32)
 
+    tail = min(TAIL, block)
     partials = pl.pallas_call(
-        _make_partials_kernel_signed(n_windows),
+        _make_partials_kernel_signed(n_windows, block),
         grid=(grid,),
         in_specs=[const_spec] + [limb_spec] * 4 + [digit_spec],
         out_specs=[wsum_spec] * 4,
         out_shape=[wsum_shape] * 4,
-        scratch_shapes=[pltpu.VMEM((TABLE_SIGNED, NLIMB, block), jnp.int32)] * 4,
+        scratch_shapes=[pltpu.VMEM((TABLE_SIGNED, NLIMB, block), jnp.int32)] * 4
+        + [pltpu.VMEM((NLIMB, n_windows * tail), jnp.int32)] * 4,
     )
 
     combine = pl.pallas_call(

@@ -6,15 +6,15 @@ policy). Falls back to the Python LogEngine automatically if the toolchain
 is unavailable (``store._default_engine``).
 
 Interchangeable on disk with the Python engine: identical record format,
-including torn-tail crash replay. Meta records (small atomic-replace files
-with optional fsync) reuse the same scheme as the Python engine so both
-are drop-in for consensus state persistence.
+including torn-tail crash replay. Meta records share the Python engine's
+``MetaLog`` append file (optional fsync) so both engines are drop-in for
+consensus state persistence.
 """
 
 from __future__ import annotations
 
 import ctypes
-import hashlib
+
 import os
 import subprocess
 
@@ -86,6 +86,7 @@ class NativeEngine:
         if not self._handle:
             raise OSError(f"failed to open native store at {path}")
         self._lib = lib
+        self._metalog = None  # lazily opened MetaLog
 
     def put(self, key: bytes, value: bytes) -> None:
         rc = self._lib.hs_store_put(self._handle, key, len(key), value, len(value))
@@ -102,33 +103,29 @@ class NativeEngine:
             raise OSError("native store read failed")
         return buf.raw
 
-    # Meta records: same atomic-replace files as the Python engine.
-    def _meta_path(self, key: bytes) -> str:
-        return os.path.join(
-            self._path, "meta_" + hashlib.sha256(key).hexdigest()[:16]
-        )
+    # Meta records: the same shared MetaLog append file as the Python
+    # engine (with fallback reads of the legacy per-key replace files).
+    @property
+    def _meta_log(self):
+        if self._metalog is None:
+            from hotstuff_tpu.store import MetaLog
+
+            self._metalog = MetaLog(self._path)
+        return self._metalog
 
     def put_meta(self, key: bytes, value: bytes, sync: bool = False) -> None:
-        path = self._meta_path(key)
-        tmp = path + ".tmp"
-        with open(tmp, "wb") as f:
-            f.write(value)
-            if sync:
-                f.flush()
-                os.fsync(f.fileno())
-        os.replace(tmp, path)
+        self._meta_log.put(key, value, sync=sync)
 
     def get_meta(self, key: bytes) -> bytes | None:
-        try:
-            with open(self._meta_path(key), "rb") as f:
-                return f.read()
-        except FileNotFoundError:
-            return None
+        return self._meta_log.get(key)
 
     def close(self) -> None:
         if self._handle:
             self._lib.hs_store_close(self._handle)
             self._handle = None
+        if self._metalog is not None:
+            self._metalog.close()
+            self._metalog = None
 
     def __del__(self) -> None:
         try:

@@ -28,7 +28,8 @@ def spawn_core(name_idx: int, committee, store=None, timeout_delay=10_000, **cor
     """Wire a Core with real channels; returns the handles a test needs."""
     pk, sk = keys()[name_idx]
     store = store or Store()
-    tx_message, tx_loopback = asyncio.Queue(), asyncio.Queue()
+    tx_message = asyncio.Queue()
+    tx_loopback = tx_message  # merged event queue (loopback items are tagged)
     tx_proposer, tx_commit = asyncio.Queue(), asyncio.Queue()
     tx_mempool = asyncio.Queue()
     synchronizer = Synchronizer(pk, committee, store, tx_loopback, 10_000)
