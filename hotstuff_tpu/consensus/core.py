@@ -270,7 +270,7 @@ class Core:
         if qc is None:
             return None
         try:
-            await verify_off_loop(qc.verify, self.committee)
+            await verify_off_loop(qc.verify, self.committee, n_sigs=len(qc.votes))
             return qc
         except BackendUnavailable as e:
             # The assembled QC was NOT judged (device/tunnel failure). Its
@@ -315,7 +315,7 @@ class Core:
         if qc.round < self.round:
             return  # the protocol moved on
         try:
-            await verify_off_loop(qc.verify, self.committee)
+            await verify_off_loop(qc.verify, self.committee, n_sigs=len(qc.votes))
         except BackendUnavailable:
             self._schedule_qc_retry(qc, attempt + 1)
             return
@@ -357,7 +357,7 @@ class Core:
                         bad.append((pk, sig))
                 return good, bad
 
-            _, bad = await verify_off_loop(split)
+            _, bad = await verify_off_loop(split, n_sigs=len(current.votes))
             if not bad:
                 # Every signature verified individually (a stricter check
                 # than the failed cofactored batch): the QC stands.
@@ -392,7 +392,9 @@ class Core:
         log.debug("Processing %r", timeout)
         if timeout.round < self.round:
             return
-        await verify_off_loop(timeout.verify, self.committee)
+        await verify_off_loop(
+            timeout.verify, self.committee, n_sigs=1 + len(timeout.high_qc.votes)
+        )
         await self.process_qc(timeout.high_qc)
         tc = self.aggregator.add_timeout(timeout)
         if tc is not None:
@@ -467,7 +469,8 @@ class Core:
             raise WrongLeader(
                 f"block {digest} from {block.author} at round {block.round}"
             )
-        await verify_off_loop(block.verify, self.committee)
+        n_sigs = 1 + len(block.qc.votes) + (len(block.tc.votes) if block.tc else 0)
+        await verify_off_loop(block.verify, self.committee, n_sigs=n_sigs)
         await self.process_qc(block.qc)
         if block.tc is not None:
             await self.advance_round(block.tc.round)
@@ -477,7 +480,7 @@ class Core:
         await self.process_block(block)
 
     async def handle_tc(self, tc: TC) -> None:
-        await verify_off_loop(tc.verify, self.committee)
+        await verify_off_loop(tc.verify, self.committee, n_sigs=len(tc.votes))
         if tc.round < self.round:
             return
         await self.advance_round(tc.round)
@@ -493,7 +496,12 @@ class Core:
         while True:
             await self.timer.wait()
             self._timer_handled.clear()
-            await self.rx_message.put(("timer", None))
+            # Tag the expiry with the round it fired in: under backlog the
+            # event can be dequeued long after the round advanced (and
+            # advancing reset the timer), and acting on it then would call
+            # increase_last_voted_round for the NEW round — suppressing
+            # this node's vote there for no reason.
+            await self.rx_message.put(("timer", self.round))
             await self._timer_handled.wait()
 
     async def run(self) -> None:
@@ -523,7 +531,11 @@ class Core:
             while True:
                 kind, payload = await self.rx_message.get()
                 if kind == "timer":
-                    await self._guarded(self.local_timeout_round())
+                    # Stale expiry (the round advanced while the event sat
+                    # in the queue): drop it — the reset timer covers the
+                    # current round.
+                    if payload == self.round:
+                        await self._guarded(self.local_timeout_round())
                     self._timer_handled.set()
                     continue
                 handler = handlers.get(kind)

@@ -57,22 +57,29 @@ def _executor() -> ThreadPoolExecutor:
 
 def _backend_blocks() -> bool:
     """True when the active crypto backend can block the loop for long
-    (device round trips / super-batching windows). CPU verifications are
-    sub-millisecond native calls: dispatching them to the worker pool costs
-    more (executor queue hop + thread wake + GIL churn, straight on the
-    vote path) than running them inline — on a single-core host it is pure
-    loss, since the loop would only be idle-waiting anyway."""
+    (device round trips / super-batching windows)."""
     from hotstuff_tpu.crypto import get_backend
 
     return "tpu" in getattr(get_backend(), "name", "")
 
 
-async def verify_off_loop(verify_fn, *args):
+# Below this many signatures a CPU verification is cheap enough (sub-ms
+# native calls) that the executor hop (queue + thread wake + GIL churn,
+# straight on the vote path) costs more than running it inline. Above it —
+# committee-scale QCs run 8-38 ms/round at N=400-1000 on this box
+# (results/committee-crypto-cpu-*.txt) — an inline call head-of-line-blocks
+# timers, ACK pumps, and network reads, and the native ctypes verifier
+# releases the GIL, so the executor genuinely overlaps on multi-core hosts.
+INLINE_SIG_LIMIT = 64
+
+
+async def verify_off_loop(verify_fn, *args, n_sigs: int = 1):
     """Run a blocking verification callable without head-of-line-blocking
     the event loop; re-raises its exception (ConsensusError/CryptoError) in
-    the awaiting task. Device-backed verifications go to the worker pool;
-    CPU ones run inline (see ``_backend_blocks``)."""
-    if not _backend_blocks():
+    the awaiting task. Device-backed verifications and large CPU batches
+    (``n_sigs >= INLINE_SIG_LIMIT``) go to the worker pool; small CPU ones
+    run inline (see ``INLINE_SIG_LIMIT``)."""
+    if not _backend_blocks() and n_sigs < INLINE_SIG_LIMIT:
         return verify_fn(*args)
     loop = asyncio.get_running_loop()
     return await loop.run_in_executor(_executor(), lambda: verify_fn(*args))

@@ -75,7 +75,11 @@ class TpuBackend:
                         msgs, pubs, sigs, self._cache
                     )
                 except self._ops.CacheFull:
-                    self._cache = None  # 64k distinct signers: stop caching
+                    # Keys accumulate across epochs with no eviction; only
+                    # the CURRENT epoch's committee is ever live, so start a
+                    # fresh cache (repopulated by subsequent batches) rather
+                    # than losing the cached path for the process lifetime.
+                    self._cache = self._ops.DevicePointCache()
                     ok = self._ops.verify_batch_device(msgs, pubs, sigs)
             else:
                 ok = self._ops.verify_batch_device(msgs, pubs, sigs)

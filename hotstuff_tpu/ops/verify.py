@@ -274,7 +274,7 @@ class DevicePointCache:
         with self._lock:
             fresh = []
             for e in dict.fromkeys(encs):  # dedup, keep order
-                if e in self._invalid:
+                if len(e) != 32 or e in self._invalid:
                     return False
                 if e not in self._rows:
                     # host-side canonicality (y < p), mirroring prepare_batch
@@ -362,6 +362,13 @@ def prepare_batch_cached(msgs, pubs, sigs, cache: DevicePointCache, _rng=None):
     cached keys)."""
     randbits = _rng.getrandbits if _rng is not None else secrets.randbits
 
+    # Length checks BEFORE cache.ensure: a wrong-length pub inside ensure
+    # would surface as a numpy shape error (read upstream as an
+    # infrastructure outage), not the rejection prepare_batch returns.
+    for pub, sig in zip(pubs, sigs):
+        if len(sig) != 64 or len(pub) != 32:
+            return None
+
     if not cache.ensure(pubs):
         return None
 
@@ -372,9 +379,7 @@ def prepare_batch_cached(msgs, pubs, sigs, cache: DevicePointCache, _rng=None):
     full_scalars: list[int] = []
     b_coeff = 0
     for i, (msg, pub, sig) in enumerate(zip(msgs, pubs, sigs)):
-        if len(sig) != 64 or len(pub) != 32:
-            return None
-        r_enc, s_bytes = sig[:32], sig[32:]
+        r_enc, s_bytes = sig[:32], sig[32:]  # lengths validated above
         s = int.from_bytes(s_bytes, "little")
         if s >= L:
             return None

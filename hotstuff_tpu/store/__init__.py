@@ -54,7 +54,25 @@ class MetaLog:
         self._meta: dict[bytes, bytes] = {}
         self._records = 0
         self._replay()
+        existed = os.path.exists(self._path)
         self._f = open(self._path, "ab")
+        if not existed:
+            # A durable (sync=True) put into a file whose directory entry
+            # was never fsynced can vanish wholesale on power failure on
+            # some filesystems: persist the creation itself.
+            self._fsync_dir()
+
+    def _fsync_dir(self) -> None:
+        try:
+            fd = os.open(self._dir, os.O_RDONLY)
+        except OSError:
+            return  # platform without directory fds: best effort
+        try:
+            os.fsync(fd)
+        except OSError:
+            pass  # directory fsync unsupported (NFS/FUSE): best effort
+        finally:
+            os.close(fd)
 
     def _replay(self) -> None:
         if not os.path.exists(self._path):
@@ -83,6 +101,9 @@ class MetaLog:
         )
 
     def put(self, key: bytes, value: bytes, sync: bool = False) -> None:
+        # The in-memory map updates only after the write path completes: on
+        # OSError (disk full, IO error) callers never observe a value that
+        # may not survive restart; replay truncates any torn partial record.
         self._f.write(_HDR.pack(len(key), len(value)) + key + value)
         self._f.flush()
         if sync:
@@ -104,6 +125,9 @@ class MetaLog:
             os.fsync(f.fileno())
         self._f.close()
         os.replace(tmp, self._path)
+        # Persist the rename: without a directory fsync the replace can be
+        # lost on power failure, resurrecting the (deleted) old log.
+        self._fsync_dir()
         self._f = open(self._path, "ab")
         self._records = len(self._meta)
 

@@ -310,3 +310,26 @@ async def test_byzantine_vote_ejected_and_quorum_recovers():
             break
     node["task"].cancel()
     node["sync"].shutdown()
+
+
+@async_test
+async def test_verify_off_loop_gates_inline_on_batch_size():
+    """CPU-backend verifications run inline only below INLINE_SIG_LIMIT;
+    committee-scale batches (8-38 ms at N=400-1000) go to the worker pool so
+    they cannot head-of-line-block timers and network reads (advisor
+    finding, round 2)."""
+    import threading
+
+    from hotstuff_tpu.consensus import crypto_bridge as cb
+
+    loop_thread = threading.get_ident()
+    seen = {}
+
+    def probe():
+        seen["thread"] = threading.get_ident()
+        return 42
+
+    assert await cb.verify_off_loop(probe) == 42
+    assert seen["thread"] == loop_thread, "single-sig CPU verify must inline"
+    assert await cb.verify_off_loop(probe, n_sigs=cb.INLINE_SIG_LIMIT) == 42
+    assert seen["thread"] != loop_thread, "large CPU batch must use the pool"

@@ -226,3 +226,43 @@ async def test_sync_request_on_missing_parent():
     node["sync"].shutdown()
     for t in other_listeners:
         t.cancel()
+
+
+@async_test
+async def test_stale_timer_event_does_not_suppress_vote():
+    """A timer expiry queued for round R must be dropped if the round
+    advanced before the event was dequeued: acting on it would call
+    increase_last_voted_round for the NEW round, silently suppressing this
+    node's vote there (advisor finding, round 2)."""
+    committee = consensus_committee(BASE + 150)
+    me = 0
+    node = spawn_core(me, committee, timeout_delay=60_000)
+    listeners = [
+        asyncio.create_task(listener(a.address[1]))
+        for a in committee.authorities.values()
+    ]
+    await asyncio.sleep(0.05)
+    # Simulate a stale expiry: round 1's timer fired but the event sat in
+    # the queue while the round advanced to 2 (qc processing). Inject the
+    # tagged event for OLD round 1 after forcing the round forward.
+    blocks = chain(2)
+    await node["rx"].put(("propose", blocks[0]))
+    await asyncio.sleep(0.2)
+    await node["rx"].put(("propose", blocks[1]))  # advances to round 2 via qc1
+    await asyncio.sleep(0.2)
+    await node["rx"].put(("timer", 1))  # stale: fired in round 1
+    await asyncio.sleep(0.2)
+    # The node must still be willing to vote in its current round: a stale
+    # expiry must NOT have bumped last_voted_round past it. Feed round 3.
+    blocks3 = chain(3)
+    await node["rx"].put(("propose", blocks3[2]))
+    # If the stale timer suppressed the vote, no frame arrives on the next
+    # leader's socket and no timeout broadcast happens either.
+    await asyncio.sleep(0.3)
+    frames = [t.result() for t in listeners if t.done()]
+    votes = [f for f in frames if decode_message(f)[0] == "vote"]
+    assert votes, "stale timer event suppressed the node's vote"
+    for t in listeners:
+        t.cancel()
+    node["task"].cancel()
+    node["sync"].shutdown()
