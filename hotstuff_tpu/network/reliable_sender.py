@@ -30,6 +30,7 @@ import logging
 import random
 from collections import deque
 
+from .budget import BUDGET
 from .receiver import read_frame, write_frame
 
 log = logging.getLogger("network")
@@ -58,8 +59,33 @@ class _Connection:
         self.capacity = asyncio.Event()
         self.capacity.set()
         self.new_work = asyncio.Event()
+        self.evicted = False
         self.task = asyncio.create_task(self._keep_alive())
         self.pump_task = asyncio.create_task(self._pump())
+        BUDGET.register(self)
+
+    def evictable(self) -> bool:
+        # Only a fully-drained connection may be closed: nothing queued,
+        # nothing awaiting (re)transmission, nothing un-ACKed. Every
+        # outstanding CancelHandler keeps the connection pinned, so the
+        # at-least-once contract survives eviction.
+        if self.live == 0 and self.pending:
+            # live == 0 means every handler is done, and an entry sitting
+            # in ``pending`` (un-transmitted, or reassembled after a
+            # disconnect before its ACK) can only have completed by
+            # cancellation — ACKed entries leave via the ack_loop. The
+            # leftovers are all dead: without this, a cancelled message to
+            # a crashed peer (whose _run never executes, so _prune never
+            # runs) would pin the connection forever, exempting dead-peer
+            # connections from the fd budget in exactly the storm regime
+            # it exists for.
+            self.pending.clear()
+        return self.live == 0 and not self.pending and self.queue.empty()
+
+    def evict(self) -> None:
+        self.evicted = True
+        self.task.cancel()
+        self.pump_task.cancel()
 
     def _prune(self) -> None:
         self.pending = deque(
@@ -99,21 +125,24 @@ class _Connection:
     async def _keep_alive(self) -> None:
         host, port = self.address
         delay = RETRY_DELAY_MS
-        while True:
-            try:
-                reader, writer = await asyncio.open_connection(host, port)
-            except OSError as e:
-                log.debug("retrying %s:%d in %dms: %s", host, port, delay, e)
-                await asyncio.sleep(delay / 1000)
-                delay = min(delay * 2, RETRY_CAP_MS)
-                continue
-            delay = RETRY_DELAY_MS
-            try:
-                await self._run(reader, writer)
-            except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
-                log.debug("connection to %s:%d dropped: %s", host, port, e)
-            finally:
-                writer.close()
+        try:
+            while True:
+                try:
+                    reader, writer = await asyncio.open_connection(host, port)
+                except OSError as e:
+                    log.debug("retrying %s:%d in %dms: %s", host, port, delay, e)
+                    await asyncio.sleep(delay / 1000)
+                    delay = min(delay * 2, RETRY_CAP_MS)
+                    continue
+                delay = RETRY_DELAY_MS
+                try:
+                    await self._run(reader, writer)
+                except (ConnectionError, OSError, asyncio.IncompleteReadError) as e:
+                    log.debug("connection to %s:%d dropped: %s", host, port, e)
+                finally:
+                    writer.close()
+        finally:
+            BUDGET.unregister(self)
 
     async def _run(self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter):
         self._prune()
@@ -173,7 +202,7 @@ class ReliableSender:
 
     def _connection(self, address: tuple[str, int]) -> _Connection:
         conn = self._connections.get(address)
-        if conn is None or conn.task.done():
+        if conn is None or conn.evicted or conn.task.done():
             conn = _Connection(address)
             self._connections[address] = conn
         return conn
@@ -190,6 +219,7 @@ class ReliableSender:
         handler: CancelHandler = asyncio.get_running_loop().create_future()
         conn = self._connection(address)
         await conn.queue.put((data, handler))
+        BUDGET.touch(conn)
         return handler
 
     async def broadcast(

@@ -12,6 +12,7 @@ import asyncio
 import logging
 import random
 
+from .budget import BUDGET
 from .receiver import read_frame, write_frame
 
 log = logging.getLogger("network")
@@ -23,26 +24,45 @@ class _Connection:
     def __init__(self, address: tuple[str, int]) -> None:
         self.address = address
         self.queue: asyncio.Queue[bytes] = asyncio.Queue(QUEUE_CAPACITY)
+        self.evicted = False
+        self._writing = False
         self.task = asyncio.create_task(self._run())
+        BUDGET.register(self)
+
+    def evictable(self) -> bool:
+        # ``_writing`` guards the frame popped from the queue but still in
+        # ``drain()`` — cancelling mid-write would tear it on the wire.
+        return self.queue.empty() and not self._writing
+
+    def evict(self) -> None:
+        # Best-effort channel: closing an idle connection loses nothing;
+        # the owner spawns a fresh one on the next send.
+        self.evicted = True
+        self.task.cancel()
 
     async def _run(self) -> None:
         host, port = self.address
         try:
-            reader, writer = await asyncio.open_connection(host, port)
-        except OSError as e:
-            log.debug("failed to connect to %s:%d: %s", host, port, e)
-            return
-        sink = asyncio.create_task(self._sink_replies(reader))
-        try:
-            while True:
-                data = await self.queue.get()
-                write_frame(writer, data)
-                await writer.drain()
-        except (ConnectionError, OSError) as e:
-            log.debug("connection to %s:%d died: %s", host, port, e)
+            try:
+                reader, writer = await asyncio.open_connection(host, port)
+            except OSError as e:
+                log.debug("failed to connect to %s:%d: %s", host, port, e)
+                return
+            sink = asyncio.create_task(self._sink_replies(reader))
+            try:
+                while True:
+                    data = await self.queue.get()
+                    self._writing = True
+                    write_frame(writer, data)
+                    await writer.drain()
+                    self._writing = False
+            except (ConnectionError, OSError) as e:
+                log.debug("connection to %s:%d died: %s", host, port, e)
+            finally:
+                sink.cancel()
+                writer.close()
         finally:
-            sink.cancel()
-            writer.close()
+            BUDGET.unregister(self)
 
     async def _sink_replies(self, reader: asyncio.StreamReader) -> None:
         try:
@@ -52,10 +72,11 @@ class _Connection:
             pass
 
     def try_send(self, data: bytes) -> bool:
-        if self.task.done():
+        if self.evicted or self.task.done():
             return False
         try:
             self.queue.put_nowait(data)
+            BUDGET.touch(self)
             return True
         except asyncio.QueueFull:
             log.warning("dropping message to %s: channel full", self.address)

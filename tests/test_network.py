@@ -357,3 +357,105 @@ async def test_cancelled_handler_skips_replay():
     )
     assert ack == b"Ack"
     sender.shutdown()
+
+
+@async_test
+async def test_connection_budget_evicts_idle_simple_connections():
+    """Above the process fd budget, idle SimpleSender connections are
+    closed LRU-first; sends to an evicted peer transparently reconnect.
+    (The N=100 one-process committee is a ~20k-connection full mesh
+    against RLIMIT_NOFILE=20k — without reaping it EMFILE-storms.)"""
+    from hotstuff_tpu.network.budget import BUDGET
+
+    ports = [BASE_PORT + 40 + i for i in range(6)]
+    handlers = []
+    receivers = []
+    for p in ports:
+        h = _EchoHandler()
+        handlers.append(h)
+        receivers.append(await Receiver.spawn(("127.0.0.1", p), h))
+
+    old_cap = BUDGET.cap
+    BUDGET.cap = 3
+    sender = SimpleSender()
+    try:
+        for p in ports:
+            sender.send(("127.0.0.1", p), b"m1")
+        await asyncio.sleep(0.3)
+        assert len(BUDGET) <= 3, "budget must reap down to cap"
+        assert BUDGET.evictions >= 3
+        # The first (LRU) peers were evicted; a new send must still arrive.
+        sender.send(("127.0.0.1", ports[0]), b"m2")
+        await asyncio.sleep(0.3)
+        assert handlers[0].received == [b"m1", b"m2"]
+    finally:
+        BUDGET.cap = old_cap
+        sender.shutdown()
+        for r in receivers:
+            await r.shutdown()
+
+
+@async_test
+async def test_connection_budget_never_evicts_unacked_reliable():
+    """A ReliableSender connection with an un-ACKed (live) message is
+    pinned: the at-least-once contract survives budget pressure. Idle
+    (fully-ACKed) reliable connections are evicted and reconnect on the
+    next send."""
+    from hotstuff_tpu.network.budget import BUDGET
+
+    dead_port = BASE_PORT + 50  # no listener: message stays live forever
+    live_ports = [BASE_PORT + 51 + i for i in range(4)]
+    handlers_srv = []
+    receivers = []
+    for p in live_ports:
+        h = _EchoHandler()
+        handlers_srv.append(h)
+        receivers.append(await Receiver.spawn(("127.0.0.1", p), h))
+
+    old_cap = BUDGET.cap
+    BUDGET.cap = 2
+    sender = ReliableSender()
+    try:
+        pinned = await sender.send(("127.0.0.1", dead_port), b"must-not-drop")
+        acked = []
+        for p in live_ports:
+            acked.append(await sender.send(("127.0.0.1", p), b"ok"))
+        for h in acked:
+            assert await asyncio.wait_for(h, 5) == b"Ack"
+        await asyncio.sleep(0.2)
+        conns = sender._connections
+        assert not conns[("127.0.0.1", dead_port)].evicted, (
+            "live (un-ACKed) connection must never be evicted"
+        )
+        assert not pinned.done()
+        # Evicted idle peer still reachable through a fresh connection.
+        evicted_port = next(
+            p for p in live_ports if conns[("127.0.0.1", p)].evicted
+        )
+        h2 = await sender.send(("127.0.0.1", evicted_port), b"again")
+        assert await asyncio.wait_for(h2, 5) == b"Ack"
+        pinned.cancel()
+    finally:
+        BUDGET.cap = old_cap
+        sender.shutdown()
+        for r in receivers:
+            await r.shutdown()
+
+
+@async_test
+async def test_connection_budget_reclaims_dead_peer_after_cancellation():
+    """A connection to a crashed peer whose only message was CANCELLED
+    (proposer reached 2f+1 ACKs elsewhere) must become evictable: its
+    _run never executes, so only evictable() can prune the dead entry.
+    Otherwise dead-peer connections are exempt from the fd budget in
+    exactly the timeout-storm regime it exists for."""
+    dead_port = BASE_PORT + 60  # nothing listens
+    sender = ReliableSender()
+    handler = await sender.send(("127.0.0.1", dead_port), b"doomed")
+    await asyncio.sleep(0.3)  # pump seats it in pending; connect keeps failing
+    conn = sender._connections[("127.0.0.1", dead_port)]
+    assert not conn.evictable(), "un-cancelled message must pin the connection"
+    handler.cancel()
+    await asyncio.sleep(0.05)  # let the done-callback drop live to 0
+    assert conn.evictable(), "cancelled-only pending must not pin a dead peer"
+    sender.shutdown()
