@@ -27,12 +27,18 @@ from hotstuff_tpu.utils.jaxcache import enable_persistent_cache
 enable_persistent_cache()
 
 
+class TunnelDown(Exception):
+    """The device-aliveness probe failed: an outage, not a code defect."""
+
+
 def probe_device(attempts: int = 4, backoff_s: float = 5.0) -> None:
     """Cheap device-aliveness check with bounded retry.
 
     A trivial op round-trip (no custom kernels) distinguishes "tunnel is
     down" from "compile is slow" in seconds instead of burning the whole
-    budget on a doomed warm-up. Raises the last error if all attempts fail.
+    budget on a doomed warm-up. Raises ``TunnelDown`` (wrapping the last
+    error) if all attempts fail, so callers classify it as an outage
+    rather than a device-code defect.
     """
     import jax
     import jax.numpy as jnp
@@ -51,7 +57,7 @@ def probe_device(attempts: int = 4, backoff_s: float = 5.0) -> None:
             )
             if attempt + 1 < attempts:  # no pointless sleep before raising
                 time.sleep(backoff_s * (2**attempt))
-    raise last if last is not None else RuntimeError("unreachable")
+    raise TunnelDown(repr(last))
 
 
 def make_batch(n_sigs: int, seed: int = 2024):
@@ -247,7 +253,12 @@ def main() -> None:
         try:
             dev_s, dev_cold_s = fut.result(timeout=budget)
         except FutTimeout:
-            fallback("TPU_UNREACHABLE")
+            # rc=2: the label is honest AND the exit code is — an
+            # unreachable device must not read as a green run in recorded
+            # harness results (distinct from DEVICE_ERROR's rc=1).
+            fallback("TPU_UNREACHABLE", code=2)
+        except TunnelDown:
+            fallback("TPU_UNREACHABLE", code=2)
         except KeyboardInterrupt:
             fallback("INTERRUPTED", code=130)
         except Exception:

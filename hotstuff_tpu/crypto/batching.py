@@ -9,12 +9,16 @@ multiple QCs from pipelined rounds, proposals being verified while votes
 aggregate, or many in-process validators sharing one device — into one
 device dispatch, amortizing the per-call round trip.
 
-Mechanics: verification requests from the crypto worker threads join a
-small collection window (first arrival opens it); the opener flushes the
-merged batch through the inner backend. If the merged batch fails, each
-request is re-verified separately so one byzantine QC cannot poison its
-neighbors' verdicts (requests keep exact per-request acceptance).
-Thread-safe; no asyncio dependency (it sits below the bridge).
+Mechanics: back-pressure batching, no timer. A request arriving while
+the device is IDLE flushes immediately — a lone QC pays zero added
+latency (round 2 charged it a fixed 2 ms collection window). Requests
+arriving while an inner call is IN FLIGHT pool up and are fused into one
+call the moment the device frees, so fusion kicks in exactly under the
+contention that needs it, sized by the device's own round-trip time. If
+a merged batch fails, each request is re-verified separately so one
+byzantine QC cannot poison its neighbors' verdicts (requests keep exact
+per-request acceptance). Thread-safe; no asyncio dependency (it sits
+below the bridge).
 """
 
 from __future__ import annotations
@@ -36,16 +40,23 @@ class _Request:
 
 
 class BatchingBackend:
-    """Wraps any backend; fuses concurrent ``verify_batch`` calls."""
+    """Wraps any backend; fuses concurrent ``verify_batch`` calls.
 
-    def __init__(self, inner, window_ms: float = 2.0, max_sigs: int = 8192) -> None:
+    ``window_ms`` is accepted for backward compatibility and ignored:
+    collection is driven by device back-pressure (requests pool only
+    while an inner call is in flight), not by a timer.
+    """
+
+    def __init__(
+        self, inner, window_ms: float | None = None, max_sigs: int = 8192
+    ) -> None:
         self.inner = inner
         self.name = f"{inner.name}+superbatch"
-        self.window = window_ms / 1000.0
         self.max_sigs = max_sigs
         self._lock = threading.Lock()
+        self._cv = threading.Condition(self._lock)
         self._pending: list[_Request] = []
-        self._flusher_active = False
+        self._thread: threading.Thread | None = None
         # Observability: how many inner calls vs requests (exposed for
         # tests and diagnostics).
         self.fused_requests = 0
@@ -55,28 +66,43 @@ class BatchingBackend:
         if not len(msgs) == len(pubs) == len(sigs):
             raise CryptoError("batch length mismatch")
         req = _Request(list(msgs), list(pubs), list(sigs))
-        with self._lock:
+        with self._cv:
             self._pending.append(req)
-            i_flush = not self._flusher_active
-            if i_flush:
-                self._flusher_active = True
-        if i_flush:
-            # Collection window: let concurrent requests pile in.
-            import time
-
-            time.sleep(self.window)
-            self._flush()
+            if self._thread is None:
+                # Dedicated daemon flusher, started on first use. A
+                # caller-thread flusher (the previous design) either
+                # stalls its own caller for unbounded time under
+                # sustained traffic (it must drain pools that keep
+                # refilling) or strands the pool when it exits — a
+                # dedicated thread has neither failure mode, and an idle
+                # device still flushes a lone QC immediately (one
+                # condition-variable wake away, ~tens of µs).
+                self._thread = threading.Thread(
+                    target=self._flusher_loop, daemon=True, name="superbatch"
+                )
+                self._thread.start()
+            self._cv.notify()
         req.done.wait()
         if req.error is not None:
             raise req.error
 
-    def _flush(self) -> None:
-        with self._lock:
-            batch = self._pending
-            self._pending = []
-            self._flusher_active = False
-        if not batch:
-            return
+    def _flusher_loop(self) -> None:
+        while True:
+            with self._cv:
+                while not self._pending:
+                    self._cv.wait()
+                batch = self._pending
+                self._pending = []
+            try:
+                self._flush(batch)
+            except BaseException:  # noqa: BLE001
+                # _flush's own finally released every waiter (error set,
+                # never silently accepted); the flusher must survive even
+                # interpreter-level interrupts or all later requests
+                # would wait forever.
+                pass
+
+    def _flush(self, batch: list[_Request]) -> None:
         self.fused_requests += len(batch)
         fused_ok = False
         try:
@@ -123,7 +149,9 @@ class BatchingBackend:
                     r.done.set()
 
 
-def enable_superbatching(window_ms: float = 2.0, max_sigs: int = 8192) -> BatchingBackend:
+def enable_superbatching(
+    window_ms: float | None = None, max_sigs: int = 8192
+) -> BatchingBackend:
     """Wrap the currently-selected backend (idempotent)."""
     current = get_backend()
     if isinstance(current, BatchingBackend):

@@ -50,13 +50,15 @@ class TpuBackend:
         # Committee point cache: validator keys decompress once and stay
         # device-resident (committees are static per epoch); per-QC work is
         # then R-decompress + signed-digit MSM only. HOTSTUFF_TPU_CACHE=0
-        # reverts to the full-decompress path. The sharded mesh path has its
-        # own lane layout and does not consult the cache, so skip building
-        # it there.
+        # reverts to the full-decompress path. On a mesh the cache array is
+        # replicated and the cached split shards across devices
+        # (``parallel.mesh.verify_batch_device_cached_sharded``).
         self._cache = None
-        if self._mesh is None and os.environ.get(
-            "HOTSTUFF_TPU_CACHE", "1"
-        ) not in ("0", "false", "no"):
+        if os.environ.get("HOTSTUFF_TPU_CACHE", "1") not in (
+            "0",
+            "false",
+            "no",
+        ):
             self._cache = _ops_verify.DevicePointCache()
 
     def verify_batch(self, msgs, pubs, sigs) -> None:
@@ -65,7 +67,17 @@ class TpuBackend:
         if not msgs:
             return
         try:
-            if self._mesh is not None:
+            if self._mesh is not None and self._cache is not None:
+                try:
+                    ok = self._pmesh.verify_batch_device_cached_sharded(
+                        self._mesh, msgs, pubs, sigs, self._cache
+                    )
+                except self._ops.CacheFull:
+                    self._cache = self._ops.DevicePointCache()
+                    ok = self._pmesh.verify_batch_device_sharded(
+                        self._mesh, msgs, pubs, sigs
+                    )
+            elif self._mesh is not None:
                 ok = self._pmesh.verify_batch_device_sharded(
                     self._mesh, msgs, pubs, sigs
                 )

@@ -422,6 +422,20 @@ def prepare_batch_cached(msgs, pubs, sigs, cache: DevicePointCache, _rng=None):
     return packed, mf, mc
 
 
+def pad_prepared_cached(packed, mf: int, mc: int, mf2: int, mc2: int):
+    """Grow a ``prepare_batch_cached`` layout to (mf2, mc2) lanes with
+    neutral rows (identity encodings / zero digits on row 0), preserving
+    the verdict. Used by the sharded mesh path to give every device an
+    equal power-of-two shard of each group."""
+    out = np.zeros((mf2 + mc2, _ROW_WIDTH), dtype=np.uint8)
+    out[:mf] = packed[:mf]
+    out[mf:mf2, 0] = 1  # identity encoding (y=1, sign 0)
+    out[mf:mf2, 32:65] = 8  # biased zero digits
+    out[mf2 : mf2 + mc] = packed[mf:]
+    out[mf2 + mc :, :64] = 8  # biased zero digits, row 0 (B * 0 = identity)
+    return out
+
+
 def verify_batch_device_cached(
     msgs, pubs, sigs, cache: DevicePointCache, _rng=None
 ) -> bool:

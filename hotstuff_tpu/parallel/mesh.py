@@ -113,10 +113,7 @@ def verify_batch_device_sharded(mesh: Mesh, msgs, pubs, sigs, _rng=None) -> bool
     packed, m = prepared
     n_dev = mesh.devices.size
     # Round lanes up so each device gets an equal power-of-two shard.
-    per_dev = max(4, -(-m // n_dev))
-    while per_dev & (per_dev - 1):
-        per_dev += 1
-    target = per_dev * n_dev
+    target = _shard_target(m, n_dev)
     if target > m:
         packed = v.pad_prepared(packed, target)
     run = _sharded_cache(mesh, target)
@@ -131,3 +128,96 @@ def _sharded_cache(mesh: Mesh, m: int):
     if key not in _VERIFIERS:
         _VERIFIERS[key] = build_verifier(mesh, m)
     return _VERIFIERS[key]
+
+
+def build_cached_verifier(mesh: Mesh, mf: int, mc: int):
+    """Sharded variant of ``ops.verify._compiled_cached``: the committee
+    point cache (device-resident, replicated across the mesh) supplies the
+    A/B points; each device decompresses its shard of the fresh R lanes and
+    accumulates partial signed MSMs for both groups; one ICI combine.
+
+    This keeps round-2's main crypto optimization on the BASELINE config-5
+    path (4096-validator vote sets sharded across a pod slice), which
+    previously fell back to full decompression."""
+    n_dev = mesh.devices.size
+    for m, nm in ((mf, "fresh"), (mc, "cached")):
+        assert m % n_dev == 0, f"{nm} lanes must divide the mesh"
+        per = m // n_dev
+        assert per & (per - 1) == 0, f"per-device {nm} lanes must be 2^k"
+
+    @jax.jit
+    @partial(
+        shard_map,
+        mesh=mesh,
+        in_specs=(P(AXIS, None), P(AXIS, None), P(None, None, None)),
+        out_specs=P(),
+        check_vma=False,  # result replicated by the explicit combine
+    )
+    def run(fresh, cached, cache_arr):
+        from hotstuff_tpu.ops.verify import (
+            _enc_to_y_limbs,
+            _kernels,
+            _signed_msm_fn,
+        )
+
+        root_fn, _ = _kernels()
+        msm_signed = _signed_msm_fn()
+        b_f = fresh.astype(jnp.int32)
+        b_c = cached.astype(jnp.int32)
+        y_limbs = _enc_to_y_limbs(b_f[:, :32])
+        ok_f, pts_f = cv.decompress(y_limbs, b_f[:, 65], root_fn=root_fn)
+        digits_f = b_f[:, 32:65].T - 8  # [33, mf/D] signed
+        rows = b_c[:, 64] | (b_c[:, 65] << 8)
+        pts_c = jnp.take(cache_arr, rows, axis=0)
+        digits_c = b_c[:, :64].T - 8  # [64, mc/D] signed
+        acc = cv.point_add(
+            msm_signed(pts_f, digits_f), msm_signed(pts_c, digits_c)
+        )
+        acc = _combine_partials(acc)
+        all_ok = jax.lax.psum(jnp.all(ok_f).astype(jnp.int32), AXIS) == n_dev
+        zero = cv.is_identity(cv.mul_by_cofactor(acc[None, ...]))[0]
+        return all_ok & zero
+
+    return run
+
+
+def _sharded_cached_cache(mesh: Mesh, mf: int, mc: int):
+    key = (id(mesh), mf, mc, "cached")
+    if key not in _VERIFIERS:
+        _VERIFIERS[key] = build_cached_verifier(mesh, mf, mc)
+    return _VERIFIERS[key]
+
+
+def _shard_target(m: int, n_dev: int) -> int:
+    """Smallest lane count >= m giving each device an equal 2^k shard."""
+    per = max(4, -(-m // n_dev))
+    while per & (per - 1):
+        per += 1
+    return per * n_dev
+
+
+def verify_batch_device_cached_sharded(
+    mesh: Mesh, msgs, pubs, sigs, cache, _rng=None
+) -> bool:
+    """Sharded variant of ``ops.verify.verify_batch_device_cached``."""
+    from hotstuff_tpu.ops import verify as v
+
+    if len(msgs) == 0:
+        return True
+    prepared = v.prepare_batch_cached(msgs, pubs, sigs, cache, _rng=_rng)
+    if prepared is None:
+        return False
+    packed, mf, mc = prepared
+    n_dev = mesh.devices.size
+    mf2 = _shard_target(mf, n_dev)
+    mc2 = _shard_target(mc, n_dev)
+    if (mf2, mc2) != (mf, mc):
+        packed = v.pad_prepared_cached(packed, mf, mc, mf2, mc2)
+    run = _sharded_cached_cache(mesh, mf2, mc2)
+    return bool(
+        run(
+            jnp.asarray(packed[:mf2]),
+            jnp.asarray(packed[mf2:]),
+            cache.array,
+        )
+    )

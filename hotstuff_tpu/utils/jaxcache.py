@@ -11,11 +11,39 @@ differently), so tests (CPU) and bench (TPU) coexist in one directory.
 
 from __future__ import annotations
 
+import hashlib
 import os
+import platform
 
 _DEFAULT_DIR = os.path.join(os.path.dirname(os.path.dirname(os.path.dirname(__file__))), ".jax_cache")
 
 _enabled = False
+
+
+def host_fingerprint() -> str:
+    """Short stable id of this host's CPU feature set.
+
+    XLA CPU executables are AOT-compiled for the build host's ISA
+    extensions; loading an entry produced under a different feature set
+    (e.g. AVX-512 code on an AVX2 box after the bench environment moves
+    hosts) SIGILLs/segfaults the interpreter — observed live in round 2.
+    Keying the cache directory by the feature flags makes a wrong-host
+    cache invisible instead of lethal. Hash input: the cpuinfo ``flags``
+    line (ISA extensions) + machine arch; kernel version and core count
+    deliberately excluded (they don't change codegen)."""
+    flags = ""
+    try:
+        with open("/proc/cpuinfo") as f:
+            for line in f:
+                if line.startswith(("flags", "Features")):  # x86 / arm
+                    flags = line.split(":", 1)[1].strip()
+                    break
+    except OSError:  # non-Linux: arch alone still partitions usefully
+        pass
+    digest = hashlib.sha256(
+        f"{platform.machine()}|{flags}".encode()
+    ).hexdigest()[:12]
+    return f"host-{digest}"
 
 
 def enable_persistent_cache(cache_dir: str | None = None) -> str:
@@ -27,6 +55,9 @@ def enable_persistent_cache(cache_dir: str | None = None) -> str:
     """
     global _enabled
     cache_dir = cache_dir or os.environ.get("HOTSTUFF_JAX_CACHE", _DEFAULT_DIR)
+    # Entries compiled under a different CPU feature set can SIGILL on
+    # load: partition by host fingerprint (see ``host_fingerprint``).
+    cache_dir = os.path.join(cache_dir, host_fingerprint())
     if _enabled:
         return cache_dir
     os.makedirs(cache_dir, exist_ok=True)

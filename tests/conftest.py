@@ -17,20 +17,83 @@ import sys
 os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
-    os.environ["XLA_FLAGS"] = (_flags + " --xla_force_host_platform_device_count=8").strip()
+    _flags = (_flags + " --xla_force_host_platform_device_count=8").strip()
+if "xla_backend_optimization_level" not in _flags:
+    # Tests assert CORRECTNESS; compiled-code speed is irrelevant, while
+    # cold-compile time is the whole suite's bottleneck (the verify
+    # mega-graphs at O3 cost 150-600 s EACH on this 1-core box; O0 cuts
+    # that ~3x). Bench paths never import this conftest and keep full
+    # optimization.
+    _flags = (_flags + " --xla_backend_optimization_level=0").strip()
+os.environ["XLA_FLAGS"] = _flags
 
 # Persistent XLA compile cache: the device-path tests cost ~570 s of CPU
 # XLA compilation per cold run; with the cache, repeat runs pay a disk
-# read. Same cache directory as bench.py (entries are keyed per backend).
-# Configured via env (read by jax at import) rather than enable_persistent
-# _cache() so tests that never touch jax don't pay the jax import here.
+# read. Same cache directory as bench.py (entries are keyed per backend),
+# partitioned by host CPU fingerprint — a cache from a different host's
+# feature set SIGILLs on load (observed round 2) and must be invisible,
+# not lethal. Configured via env (read by jax at import) rather than
+# enable_persistent_cache() so tests that never touch jax don't pay the
+# jax import here (hotstuff_tpu.utils.jaxcache itself is jax-free).
 _repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _repo)
+from hotstuff_tpu.utils.jaxcache import host_fingerprint  # noqa: E402
+
 _cache_dir = os.environ.setdefault(
-    "JAX_COMPILATION_CACHE_DIR", os.path.join(_repo, ".jax_cache")
+    "JAX_COMPILATION_CACHE_DIR",
+    os.path.join(_repo, ".jax_cache", host_fingerprint()),
 )
 os.makedirs(_cache_dir, exist_ok=True)
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_COMPILE_TIME_SECS", "0")
 os.environ.setdefault("JAX_PERSISTENT_CACHE_MIN_ENTRY_SIZE_BYTES", "-1")
+
+# The device suite's cold-compile bill (~30+ min after round-2's kernel
+# variants) cannot fit one CI/judging window: partition the device-marked
+# files into slices, each independently under a 10-minute cold window,
+# selectable with `-m device_slice1` etc. (slice markers are ADDITIVE —
+# plain `-m device` still selects everything). Cold-measured on this
+# 1-core box; every extra cache capacity / batch shape / graph variant is
+# a separate full XLA compile, which is what drives the grouping.
+_DEVICE_SLICES = {
+    "test_ops_field.py": "device_slice1",
+    "test_ops_curve.py": "device_slice1",
+    "test_sha512_device.py": "device_slice2",
+    "test_signed_msm.py": "device_slice2",
+    "test_verify_cached.py": "device_slice3",
+    "test_verify_cache_shapes.py": "device_slice4",
+    "test_tpu_backend.py": "device_slice5",
+    "test_tpu_backend_mesh.py": "device_slice6",
+}
+# Per-test overrides: a single distinctly-shaped mega-graph costs
+# ~150-250 s of XLA CPU compile on this box, so a slice can hold at most
+# two. The v1-vs-cached parity test compiles BOTH graphs at shapes
+# nothing else uses — it gets a window of its own.
+_DEVICE_SLICE_OVERRIDES = {
+    "test_cached_matches_v1_acceptance_on_mixed_batches": "device_slice7",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    import pytest
+
+    unsliced = []
+    for item in items:
+        slice_mark = _DEVICE_SLICE_OVERRIDES.get(
+            item.name, _DEVICE_SLICES.get(item.path.name)
+        )
+        if slice_mark is not None:
+            item.add_marker(getattr(pytest.mark, slice_mark))
+        elif item.get_closest_marker("device") is not None:
+            unsliced.append(item.nodeid)
+    if unsliced:
+        # CI runs the quick suite (-m "not device") plus one job per
+        # slice: a device test with no slice would run NOWHERE while CI
+        # stays green. Fail collection instead.
+        raise pytest.UsageError(
+            "device-marked tests missing a _DEVICE_SLICES entry in "
+            f"tests/conftest.py: {unsliced}"
+        )
+
 
 if "jax" in sys.modules:
     # jax read its env-derived config already: apply the same settings via

@@ -1,0 +1,106 @@
+"""Signed-digit recode + signed MSM bit-exactness vs the pure-Python
+oracle (split from test_verify_cached.py so each cold-compile slice fits
+one 10-minute CI/judging window)."""
+
+import random
+
+import numpy as np
+import pytest
+
+pytest.importorskip("jax")
+
+pytestmark = pytest.mark.device
+
+from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402
+from hotstuff_tpu.ops import curve as cv  # noqa: E402
+
+# -- signed digit recode ----------------------------------------------------
+
+
+def test_signed_digits_reconstruct_scalar():
+    rng = random.Random(1)
+    scalars = [rng.getrandbits(253) for _ in range(9)] + [0, 1, ref.L - 1]
+    digits = cv.scalars_to_signed_digits(scalars, 64)
+    assert digits.min() >= -8 and digits.max() <= 8
+    for j, s in enumerate(scalars):
+        val = 0
+        for w in range(64):
+            val = val * 16 + int(digits[w, j])
+        assert val == s
+
+
+def test_signed_digits_narrow_windows():
+    rng = random.Random(2)
+    scalars = [rng.getrandbits(128) | (1 << 127) for _ in range(7)]
+    digits = cv.scalars_to_signed_digits(scalars, 33)
+    for j, s in enumerate(scalars):
+        val = 0
+        for w in range(33):
+            val = val * 16 + int(digits[w, j])
+        assert val == s
+
+
+def test_signed_digits_from_bytes_matches_int_version():
+    rng = random.Random(3)
+    scalars = [rng.getrandbits(252) for _ in range(11)]
+    sb = np.frombuffer(
+        b"".join(s.to_bytes(32, "little") for s in scalars), dtype=np.uint8
+    ).reshape(-1, 32)
+    a = cv.signed_digits_from_bytes(sb, 64)
+    b = cv.scalars_to_signed_digits(scalars, 64)
+    assert (a == b).all()
+
+
+# -- signed MSM vs oracle ---------------------------------------------------
+
+
+def _random_points(rng, m):
+    pts, ints = [], []
+    for _ in range(m):
+        k = rng.getrandbits(250) % ref.L
+        p_int = ref.point_mul(k, ref.G)
+        ints.append(p_int)
+        enc = ref.point_compress(p_int)
+        import numpy as _np
+
+        from hotstuff_tpu.ops import field as fe
+
+        y = fe.fe_from_bytes(
+            _np.frombuffer(bytes([b & (0x7F if i == 31 else 0xFF) for i, b in enumerate(enc)]), dtype=_np.uint8)[None]
+        )[0]
+        sign = enc[31] >> 7
+        ok, pt = cv.decompress(np.asarray(y)[None], np.asarray([sign]))
+        assert bool(ok[0])
+        pts.append(np.asarray(pt[0]))
+    return np.stack(pts), ints
+
+
+def test_msm_signed_matches_oracle():
+    rng = random.Random(7)
+    m = 4
+    pts, p_ints = _random_points(rng, m)
+    scalars = [rng.getrandbits(250) % ref.L for _ in range(m)]
+    digits = cv.scalars_to_signed_digits(scalars, 64)
+    acc = cv.msm_signed(np.asarray(pts), np.asarray(digits))
+    expected = None
+    for s, p in zip(scalars, p_ints):
+        term = ref.point_mul(s, p)
+        expected = term if expected is None else ref.point_add(expected, term)
+    got = cv.to_affine_bytes(acc)
+    assert got == ref.point_compress(expected)
+
+
+def test_msm_signed_narrow_windows_matches_oracle():
+    rng = random.Random(8)
+    m = 4
+    pts, p_ints = _random_points(rng, m)
+    scalars = [rng.getrandbits(128) | (1 << 127) for _ in range(m)]
+    digits = cv.scalars_to_signed_digits(scalars, 33)
+    acc = cv.msm_signed(np.asarray(pts), np.asarray(digits))
+    expected = None
+    for s, p in zip(scalars, p_ints):
+        term = ref.point_mul(s, p)
+        expected = term if expected is None else ref.point_add(expected, term)
+    assert cv.to_affine_bytes(acc) == ref.point_compress(expected)
+
+

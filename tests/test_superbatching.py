@@ -64,27 +64,108 @@ def _run_threads(backend, requests):
     return errors
 
 
-def test_concurrent_requests_fuse_into_one_call():
+class GatedBackend(CountingBackend):
+    """First call blocks until released — models an in-flight device call
+    so tests can deterministically pool requests behind it."""
+
+    def __init__(self):
+        super().__init__()
+        self.first_entered = threading.Event()
+        self.release_first = threading.Event()
+        self._first = True
+
+    def verify_batch(self, msgs, pubs, sigs):
+        gate = self._first
+        self._first = False
+        if gate:
+            self.first_entered.set()
+            assert self.release_first.wait(10)
+        super().verify_batch(msgs, pubs, sigs)
+
+
+def test_requests_pool_behind_inflight_call_and_fuse():
+    """Back-pressure batching: requests arriving while an inner call is
+    in flight fuse into ONE follow-up call when the device frees."""
+    inner = GatedBackend()
+    backend = BatchingBackend(inner)
+    opener = threading.Thread(
+        target=backend.verify_batch, args=make_request(tag=b"opener")
+    )
+    opener.start()
+    assert inner.first_entered.wait(10)  # device now "busy"
+    requests = [make_request(tag=b"r%d" % i) for i in range(5)]
+    threads = [
+        threading.Thread(target=backend.verify_batch, args=r) for r in requests
+    ]
+    for t in threads:
+        t.start()
+    # Give all five time to pool behind the in-flight call.
+    for _ in range(100):
+        with backend._lock:
+            if len(backend._pending) == 5:
+                break
+        threading.Event().wait(0.01)
+    inner.release_first.set()
+    opener.join(10)
+    for t in threads:
+        t.join(10)
+    assert inner.calls == [3, 15], f"expected opener + one fused call, got {inner.calls}"
+    assert backend.fused_requests == 6 and backend.inner_calls == 2
+
+
+def test_lone_request_flushes_immediately():
+    """An idle device means zero added latency: a lone QC goes straight
+    through (round 2 charged it a fixed 2 ms collection window)."""
+    import time
+
     inner = CountingBackend()
-    backend = BatchingBackend(inner, window_ms=50)
-    requests = [make_request(tag=b"r%d" % i) for i in range(6)]
-    errors = _run_threads(backend, requests)
-    assert errors == [None] * 6
-    assert inner.calls == [18], f"expected one fused call, got {inner.calls}"
-    assert backend.fused_requests == 6 and backend.inner_calls == 1
+    backend = BatchingBackend(inner)
+    t0 = time.perf_counter()
+    backend.verify_batch(*make_request(tag=b"lone"))
+    elapsed = time.perf_counter() - t0
+    assert inner.calls == [3] and backend.inner_calls == 1
+    # Generous bound: the old 2 ms window alone would eat most of this.
+    assert elapsed < 1.0
 
 
 def test_byzantine_request_isolated():
-    inner = CountingBackend()
-    backend = BatchingBackend(inner, window_ms=50)
+    inner = GatedBackend()
+    backend = BatchingBackend(inner)
+    opener = threading.Thread(
+        target=backend.verify_batch, args=make_request(tag=b"opener")
+    )
+    opener.start()
+    assert inner.first_entered.wait(10)
     good = [make_request(tag=b"g%d" % i) for i in range(3)]
     bad_msgs, bad_pubs, bad_sigs = make_request(tag=b"bad")
     bad_sigs[1] = bytes(64)
-    errors = _run_threads(backend, good + [(bad_msgs, bad_pubs, bad_sigs)])
+    pooled = good + [(bad_msgs, bad_pubs, bad_sigs)]
+    errors = [None] * len(pooled)
+
+    def worker(i, req):
+        try:
+            backend.verify_batch(*req)
+        except CryptoError as e:
+            errors[i] = e
+
+    threads = [
+        threading.Thread(target=worker, args=(i, r)) for i, r in enumerate(pooled)
+    ]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        with backend._lock:
+            if len(backend._pending) == 4:
+                break
+        threading.Event().wait(0.01)
+    inner.release_first.set()
+    opener.join(10)
+    for t in threads:
+        t.join(10)
     assert errors[:3] == [None] * 3, "good requests poisoned by the bad one"
     assert isinstance(errors[3], CryptoError)
-    # One fused attempt + one isolation pass per request.
-    assert inner.calls[0] == 12 and len(inner.calls) == 5
+    # Opener + one fused attempt + one isolation pass per pooled request.
+    assert inner.calls[0] == 3 and inner.calls[1] == 12 and len(inner.calls) == 6
 
 
 def test_sequential_requests_still_work():

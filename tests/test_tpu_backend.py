@@ -9,17 +9,9 @@ pytest.importorskip("jax")
 
 pytestmark = pytest.mark.device
 
-from hotstuff_tpu.crypto import (  # noqa: E402
-    CryptoError,
-    Digest,
-    Signature,
-    set_backend,
-    sha512_digest,
-)
+from hotstuff_tpu.crypto import set_backend  # noqa: E402
 from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402
 from hotstuff_tpu.ops.verify import verify_batch_device  # noqa: E402
-
-from .common import chain, consensus_committee, keys
 
 
 @pytest.fixture(autouse=True)
@@ -83,47 +75,10 @@ def test_device_accepts_torsioned_signature_like_cpu():
     s = (r + h * a) % ref.L
     sig = r_enc + int.to_bytes(s, 32, "little")
     assert ref.verify(pub, msg, sig, strict=False)
-    assert verify_batch_device([msg], [pub], [sig], _rng=random.Random(1))
-
-
-def test_tpu_backend_through_signature_api():
-    set_backend("tpu")
-    d = sha512_digest(b"quorum certificate")
-    votes = [(pk, Signature.new(d, sk)) for pk, sk in keys(4)]
-    Signature.verify_batch(d, votes)  # must not raise
-    votes[1] = (votes[1][0], Signature(bytes(64)))
-    with pytest.raises(CryptoError):
-        Signature.verify_batch(d, votes)
-
-
-def test_tpu_backend_qc_verify():
-    set_backend("tpu")
-    committee = consensus_committee(14000)
-    blocks = chain(2)
-    blocks[1].verify(committee)  # embedded QC batch-verifies on device
-
-
-def test_tpu_backend_auto_shards_on_multidevice():
-    """On a multi-device platform (the conftest's virtual 8-CPU mesh) the
-    backend must select the lane-sharded mesh verifier automatically
-    (BASELINE config 5 wiring) — and both polarities must flow through it."""
-    import jax
-
-    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
-
-    backend = TpuBackend()
-    assert jax.device_count() > 1
-    assert backend._mesh is not None, "multi-device must auto-select the mesh"
-
-    msgs, pubs, sigs = make_batch(5, seed=21)
-    backend.verify_batch(msgs, pubs, sigs)  # must not raise
-    bad = bytearray(sigs[2])
-    bad[7] ^= 0x20
-    with pytest.raises(CryptoError):
-        backend.verify_batch(msgs, pubs, [*sigs[:2], bytes(bad), *sigs[3:]])
-
-
-def test_tpu_backend_sharded_override_off():
-    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
-
-    assert TpuBackend(sharded=False)._mesh is None
+    # Pad with two honest signatures so the lane count matches the other
+    # tests' compiled shape (m=8) — each distinct shape is a separate
+    # ~150-250 s cold XLA compile on this box.
+    msgs, pubs, sigs = make_batch(2, seed=10)
+    assert verify_batch_device(
+        [msg, *msgs], [pub, *pubs], [sig, *sigs], _rng=random.Random(1)
+    )

@@ -1,0 +1,115 @@
+"""Multi-device (mesh) TPU backend tests — the sharded and
+sharded+cached verifier graphs are separate heavy XLA compiles, so they
+get their own cold-compile slice (split from test_tpu_backend.py)."""
+
+import pytest
+
+pytest.importorskip("jax")
+
+pytestmark = pytest.mark.device
+
+from hotstuff_tpu.crypto import CryptoError  # noqa: E402
+from .test_tpu_backend import make_batch  # noqa: E402
+from hotstuff_tpu.crypto import (  # noqa: E402
+    Signature,
+    set_backend,
+    sha512_digest,
+)
+from .common import chain, consensus_committee, keys  # noqa: E402
+
+
+@pytest.fixture(autouse=True)
+def reset_backend():
+    yield
+    set_backend("cpu")
+
+
+
+def test_tpu_backend_auto_shards_on_multidevice():
+    """On a multi-device platform (the conftest's virtual 8-CPU mesh) the
+    backend must select the lane-sharded mesh verifier automatically
+    (BASELINE config 5 wiring) — and both polarities must flow through it."""
+    import jax
+
+    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
+
+    backend = TpuBackend()
+    assert jax.device_count() > 1
+    assert backend._mesh is not None, "multi-device must auto-select the mesh"
+
+    msgs, pubs, sigs = make_batch(5, seed=21)
+    backend.verify_batch(msgs, pubs, sigs)  # must not raise
+    bad = bytearray(sigs[2])
+    bad[7] ^= 0x20
+    with pytest.raises(CryptoError):
+        backend.verify_batch(msgs, pubs, [*sigs[:2], bytes(bad), *sigs[3:]])
+
+
+def test_tpu_backend_sharded_override_off():
+    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
+
+    assert TpuBackend(sharded=False)._mesh is None
+
+
+def test_tpu_backend_mesh_uses_committee_cache():
+    """BASELINE config 5: the sharded mesh path must consult the committee
+    point cache (round-2 weak #7 — it used to fall back to full
+    decompression exactly where the cache matters most). Pins both
+    acceptance polarities through the sharded+cached path and steady-state
+    row reuse. (Unsharded cached-vs-v1 acceptance parity is pinned in
+    test_verify_cached / test_verify_cache_shapes; compiling the unsharded
+    graph HERE too would blow this slice's cold window.)"""
+    import random
+
+    from hotstuff_tpu.crypto.tpu_backend import TpuBackend
+    from hotstuff_tpu.ops.verify import DevicePointCache
+    from hotstuff_tpu.parallel import make_mesh
+    from hotstuff_tpu.parallel.mesh import verify_batch_device_cached_sharded
+
+    backend = TpuBackend()
+    assert backend._mesh is not None and backend._cache is not None, (
+        "multi-device backend must keep the committee cache"
+    )
+
+    msgs, pubs, sigs = make_batch(5, seed=33)
+    mesh = make_mesh()
+    cache_a = DevicePointCache()  # default capacity: shares the backend graphs' cache-array shape
+    ok_sharded = verify_batch_device_cached_sharded(
+        mesh, msgs, pubs, sigs, cache_a, _rng=random.Random(7)
+    )
+    assert ok_sharded is True
+
+    bad = bytearray(sigs[1])
+    bad[3] ^= 0x10
+    bad_sigs = [sigs[0], bytes(bad), *sigs[2:]]
+    assert (
+        verify_batch_device_cached_sharded(
+            mesh, msgs, pubs, bad_sigs, cache_a, _rng=random.Random(8)
+        )
+        is False
+    )
+    # Steady state: repeat batches reuse the cached rows (no growth).
+    rows_before = cache_a._next_row
+    assert verify_batch_device_cached_sharded(
+        mesh, msgs, pubs, sigs, cache_a, _rng=random.Random(9)
+    )
+    assert cache_a._next_row == rows_before
+
+
+# Backend-routed paths: on a multi-device platform these flow through
+# the sharded mesh verifier, sharing its compiled graph.
+def test_tpu_backend_through_signature_api():
+    set_backend("tpu")
+    d = sha512_digest(b"quorum certificate")
+    votes = [(pk, Signature.new(d, sk)) for pk, sk in keys(4)]
+    Signature.verify_batch(d, votes)  # must not raise
+    votes[1] = (votes[1][0], Signature(bytes(64)))
+    with pytest.raises(CryptoError):
+        Signature.verify_batch(d, votes)
+
+
+def test_tpu_backend_qc_verify():
+    set_backend("tpu")
+    committee = consensus_committee(14000)
+    blocks = chain(2)
+    blocks[1].verify(committee)  # embedded QC batch-verifies on device

@@ -1,0 +1,51 @@
+"""Committee point-cache shape behavior: growth across capacities and
+acceptance parity with the v1 (uncached) path on mixed batch sizes.
+Split from test_verify_cached.py: these compile EXTRA kernel variants
+(new cache capacities, the full v1 graph) and blew the cold-compile
+window together with the core path tests."""
+
+import random
+
+import pytest
+
+pytest.importorskip("jax")
+
+pytestmark = pytest.mark.device
+
+from hotstuff_tpu.crypto import ed25519_ref as ref  # noqa: E402
+from hotstuff_tpu.ops import verify as v  # noqa: E402
+
+
+def make_batch(n=3, seed=5):
+    rng = random.Random(seed)
+    msgs, pubs, sigs = [], [], []
+    for _ in range(n):
+        seed_bytes = rng.randbytes(32)
+        pubs.append(ref.secret_to_public(seed_bytes))
+        msgs.append(rng.randbytes(32))
+        sigs.append(ref.sign(seed_bytes, msgs[-1]))
+    return msgs, pubs, sigs
+
+
+def test_cache_grows_beyond_initial_capacity():
+    cache = v.DevicePointCache(capacity=16)
+    msgs, pubs, sigs = make_batch(20, seed=17)
+    assert v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(1))
+    assert cache.capacity >= 21
+    assert len(cache._rows) == 21
+
+
+def test_cached_matches_v1_acceptance_on_mixed_batches():
+    """Same accept/reject verdicts as the v1 full-decompress path across a
+    spread of mutations."""
+    rng = random.Random(18)
+    for trial in range(4):
+        cache = v.DevicePointCache(capacity=64)
+        msgs, pubs, sigs = make_batch(3, seed=100 + trial)
+        if trial % 2:
+            bad = bytearray(sigs[trial % 3])
+            bad[trial % 32] ^= 1 << (trial % 8)
+            sigs[trial % 3] = bytes(bad)
+        v1 = v.verify_batch_device(msgs, pubs, sigs, _rng=random.Random(42))
+        v2 = v.verify_batch_device_cached(msgs, pubs, sigs, cache, _rng=random.Random(42))
+        assert v1 == v2, f"trial {trial}: v1={v1} v2={v2}"
