@@ -84,10 +84,17 @@ class Consensus:
 
         address = committee.address(name)
         assert address is not None, "our public key is not in the committee"
+        # auto_ack: the transport ACKs on frame arrival — the leader's
+        # back-pressure signal means "received" (exactly what the
+        # handler's first-line ACK meant) without waiting for this
+        # process to be scheduled. Non-proposal messages arrive via
+        # SimpleSender, which discards replies, so the extra ACK frames
+        # are harmless.
         self.receivers.append(
             await Receiver.spawn(
                 ("0.0.0.0", address[1]),
                 ConsensusReceiverHandler(tx_consensus, tx_helper),
+                auto_ack=True,
             )
         )
         log.info("Node %s listening to consensus messages on %s", name, address)

@@ -144,10 +144,14 @@ class Mempool:
         # Peer messages: batches + batch requests.
         mp_address = self.committee.mempool_address(self.name)
         assert mp_address is not None
+        # auto_ack: batch ACKs (the 2f+1 dissemination quorum) go out on
+        # frame arrival instead of after this process gets scheduled;
+        # batch_request senders use SimpleSender and discard the reply.
         self.receivers.append(
             await Receiver.spawn(
                 ("0.0.0.0", mp_address[1]),
                 MempoolReceiverHandler(tx_peer_processor, tx_helper),
+                auto_ack=True,
             )
         )
         # Peer batches: hash, store, digest to consensus.

@@ -1,0 +1,808 @@
+// Native DCN transport: epoll event loop + length-delimited framing +
+// reliable-delivery bookkeeping, exposed over a C ABI for ctypes.
+//
+// Why: the protocol plane's measured floor is Python asyncio's event
+// machinery (~15k events/s/core — docs/latency_profile.md). This moves
+// the per-event hot path (socket IO, frame reassembly, ACK pairing,
+// reconnect/replay) into one C++ epoll thread; Python sees BATCHES of
+// events through a packed buffer + eventfd, so its per-frame cost drops
+// to a dict lookup and a queue put.
+//
+// Semantics mirror the asyncio implementation (and the reference's
+// network crate, network/src/{receiver,simple_sender,reliable_sender}.rs):
+//   - frames: 4-byte big-endian length prefix (LengthDelimitedCodec)
+//   - simple sends: best-effort, connection dies on error, next send
+//     reconnects; replies read and discarded
+//   - reliable sends: per-message id resolved by the peer's ACK bytes
+//     (FIFO pairing, cancelled ids skipped), exponential backoff
+//     200ms..2x..60s, un-ACKed frames replayed across reconnects
+//   - receivers: inbound frames are events; replies (ACKs) are written
+//     back on the same connection by command
+//
+// Threading: ONE loop thread per context. Python talks to it through a
+// mutex-guarded command queue (woken by an eventfd) and reads results
+// from a mutex-guarded event buffer (signalled by a second eventfd that
+// asyncio watches with add_reader). All fds are nonblocking.
+
+#include <arpa/inet.h>
+#include <errno.h>
+#include <fcntl.h>
+#include <netinet/in.h>
+#include <netinet/tcp.h>
+#include <string.h>
+#include <sys/epoll.h>
+#include <sys/eventfd.h>
+#include <sys/socket.h>
+#include <time.h>
+#include <unistd.h>
+
+#include <atomic>
+#include <cstdint>
+#include <deque>
+#include <memory>
+#include <mutex>
+#include <string>
+#include <thread>
+#include <unordered_map>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t MAX_FRAME = 64u * 1024u * 1024u;
+constexpr size_t SIMPLE_QUEUE_CAP = 1000;   // frames; matches Python sender
+constexpr int RETRY_DELAY_MS = 200;
+constexpr int RETRY_CAP_MS = 60000;
+
+uint64_t now_ms() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000 + uint64_t(ts.tv_nsec) / 1000000;
+}
+
+void frame_append(std::string& out, const uint8_t* data, uint32_t len) {
+  char hdr[4] = {char(len >> 24), char(len >> 16), char(len >> 8), char(len)};
+  out.append(hdr, 4);
+  out.append(reinterpret_cast<const char*>(data), len);
+}
+
+// Event types surfaced to Python (see hs_net_drain record layout).
+enum : uint8_t {
+  EV_RECV = 1,    // a=listener_id, b=conn_id, payload=frame
+  EV_ACKED = 2,   // a=msg_id, payload=ACK bytes
+  EV_GONE = 3,    // a=listener_id, b=conn_id (inbound connection closed)
+};
+
+struct Event {
+  uint8_t type;
+  uint64_t a, b;
+  std::string payload;
+};
+
+enum : uint8_t {
+  CMD_SEND_SIMPLE = 1,   // addr, payload
+  CMD_SEND_RELIABLE = 2, // addr, msg_id, payload
+  CMD_CANCEL = 3,        // msg_id
+  CMD_REPLY = 4,         // conn_id, payload
+  CMD_ADD_LISTENER = 5,  // listener fd already bound+listening
+  CMD_STOP = 6,
+  CMD_CLOSE_LISTENER = 7,  // close listener + its inbound connections
+};
+
+struct Command {
+  uint8_t type;
+  std::string host;
+  uint16_t port = 0;
+  uint64_t id = 0;  // msg_id / conn_id / listener_id
+  int fd = -1;
+  bool flag = false;  // ADD_LISTENER: auto_ack
+  std::string payload;
+};
+
+// A peer that sends frames but never reads its ACKs would grow the
+// reply buffer without bound (a byzantine-facing listener must not leak
+// memory on hostile traffic): past this cap the connection is dropped.
+constexpr size_t IN_OUTBUF_CAP = 1u << 20;
+
+struct InConn {
+  int fd;
+  uint64_t id;
+  uint64_t listener_id;
+  std::string inbuf;
+  std::string outbuf;  // replies (ACKs)
+  bool auto_ack = false;
+  bool dead = false;
+};
+
+struct PendingMsg {
+  uint64_t msg_id;  // 0 for simple frames
+  std::string frame;  // already length-prefixed
+};
+
+struct OutConn {
+  uint64_t key_hash;
+  std::string host;
+  uint16_t port;
+  bool reliable;
+  int fd = -1;
+  bool connecting = false;
+  std::string inbuf;   // ACK frames (reliable) / discarded replies (simple)
+  std::string outbuf;  // bytes in the kernel-bound staging buffer
+  // reliable: frames not yet written on the CURRENT socket (replayed);
+  // simple: frames waiting for the connection to come up.
+  std::deque<PendingMsg> pending;
+  // reliable only: written on this socket, awaiting ACK (FIFO).
+  std::deque<PendingMsg> inflight;
+  int backoff_ms = RETRY_DELAY_MS;
+  uint64_t next_retry_ms = 0;  // 0 = connect now
+};
+
+struct AddrKey {
+  std::string host;
+  uint16_t port;
+  bool reliable;
+  bool operator==(const AddrKey& o) const {
+    return port == o.port && reliable == o.reliable && host == o.host;
+  }
+};
+struct AddrKeyHash {
+  size_t operator()(const AddrKey& k) const {
+    return std::hash<std::string>()(k.host) ^ (size_t(k.port) << 1) ^
+           (k.reliable ? 0x9e3779b9u : 0);
+  }
+};
+
+class NetCore {
+ public:
+  NetCore() {
+    epfd_ = epoll_create1(EPOLL_CLOEXEC);
+    cmd_efd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    out_efd_ = eventfd(0, EFD_NONBLOCK | EFD_CLOEXEC);
+    epoll_event ev{};
+    ev.events = EPOLLIN;
+    ev.data.u64 = TAG_CMD;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, cmd_efd_, &ev);
+    thread_ = std::thread([this] { loop(); });
+  }
+
+  ~NetCore() {
+    {
+      std::lock_guard<std::mutex> g(cmd_mu_);
+      Command c;
+      c.type = CMD_STOP;
+      commands_.push_back(std::move(c));
+    }
+    wake();
+    thread_.join();
+    for (auto& [id, c] : in_conns_) close(c.fd);
+    for (auto& [k, c] : out_conns_) {
+      if (c.fd >= 0) close(c.fd);
+    }
+    for (auto& [id, fd] : listener_fds_) close(fd);
+    close(epfd_);
+    close(cmd_efd_);
+    close(out_efd_);
+  }
+
+  int out_event_fd() const { return out_efd_; }
+
+  // Called from the Python thread: bind+listen synchronously (errors are
+  // immediate), hand the fd to the loop. With auto_ack, the loop thread
+  // writes an "Ack" frame back the moment a frame arrives — the sender's
+  // back-pressure signal no longer waits for the receiving PROCESS to be
+  // scheduled (handlers ACK before processing anyway, so semantics
+  // match; reference consensus.rs:144-153, mempool.rs:224-237).
+  int64_t listen_on(const char* host, uint16_t port, bool auto_ack) {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) return -errno;
+    int one = 1;
+    setsockopt(fd, SOL_SOCKET, SO_REUSEADDR, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(port);
+    if (inet_pton(AF_INET, host, &addr.sin_addr) != 1) {
+      close(fd);
+      return -EINVAL;
+    }
+    if (bind(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr) < 0 ||
+        ::listen(fd, 1024) < 0) {
+      int e = errno;
+      close(fd);
+      return -e;
+    }
+    uint64_t id = next_listener_id_++;
+    Command c;
+    c.type = CMD_ADD_LISTENER;
+    c.fd = fd;
+    c.id = id;
+    c.flag = auto_ack;
+    push_cmd(std::move(c));
+    return int64_t(id);
+  }
+
+  void push_cmd(Command&& c) {
+    {
+      std::lock_guard<std::mutex> g(cmd_mu_);
+      commands_.push_back(std::move(c));
+    }
+    wake();
+  }
+
+  // Drain events into a packed buffer:
+  //   [u8 type][u64 a][u64 b][u32 len][len bytes] ...
+  // Returns bytes written (0 = nothing pending).
+  int64_t drain(uint8_t* buf, uint32_t cap) {
+    std::lock_guard<std::mutex> g(ev_mu_);
+    size_t off = 0;
+    while (!events_.empty()) {
+      Event& e = events_.front();
+      size_t need = 1 + 8 + 8 + 4 + e.payload.size();
+      if (need > cap && off == 0) {
+        // A single event larger than the caller's buffer: report the
+        // required size as a negative count so Python can grow and
+        // retry (frames go up to MAX_FRAME).
+        return -int64_t(need);
+      }
+      if (off + need > cap) break;
+      buf[off++] = e.type;
+      memcpy(buf + off, &e.a, 8);
+      off += 8;
+      memcpy(buf + off, &e.b, 8);
+      off += 8;
+      uint32_t len = uint32_t(e.payload.size());
+      memcpy(buf + off, &len, 4);
+      off += 4;
+      memcpy(buf + off, e.payload.data(), len);
+      off += len;
+      events_.pop_front();
+    }
+    if (events_.empty()) {
+      // All consumed: clear the coalescing flag so the next emit
+      // re-signals the eventfd (the caller loops on drain until 0, so
+      // partial drains need no re-arm).
+      out_signaled_.store(false, std::memory_order_release);
+    }
+    return int64_t(off);
+  }
+
+ private:
+  static constexpr uint64_t TAG_CMD = ~0ull;
+  // epoll tags: listeners get 1<<62 | idx; inbound conns 1<<61 | id;
+  // outbound conns 1<<60 | key-slot.
+  static constexpr uint64_t TAG_LISTENER = 1ull << 62;
+  static constexpr uint64_t TAG_IN = 1ull << 61;
+  static constexpr uint64_t TAG_OUT = 1ull << 60;
+
+  // Both signals are coalesced through an atomic flag: a burst of
+  // commands (or events) costs ONE eventfd syscall, not one per item.
+  void wake() {
+    if (!cmd_signaled_.exchange(true, std::memory_order_acq_rel)) {
+      uint64_t one = 1;
+      (void)!write(cmd_efd_, &one, 8);
+    }
+  }
+
+  void signal_out() {
+    if (!out_signaled_.exchange(true, std::memory_order_acq_rel)) {
+      uint64_t one = 1;
+      (void)!write(out_efd_, &one, 8);
+    }
+  }
+
+  void emit(Event&& e) {
+    {
+      std::lock_guard<std::mutex> g(ev_mu_);
+      events_.push_back(std::move(e));
+    }
+    signal_out();
+  }
+
+  void loop() {
+    std::vector<epoll_event> evs(256);
+    while (!stop_) {
+      int timeout = next_timeout();
+      int n = epoll_wait(epfd_, evs.data(), int(evs.size()), timeout);
+      uint64_t now = now_ms();
+      for (int i = 0; i < n; i++) {
+        uint64_t tag = evs[i].data.u64;
+        uint32_t flags = evs[i].events;
+        if (tag == TAG_CMD) {
+          uint64_t junk;
+          while (read(cmd_efd_, &junk, 8) == 8) {
+          }
+          // Clear BEFORE swapping the queue: a producer enqueueing after
+          // the swap sees the flag false and re-signals.
+          cmd_signaled_.store(false, std::memory_order_release);
+          run_commands();
+        } else if (tag & TAG_LISTENER) {
+          accept_all(tag & ~TAG_LISTENER);
+        } else if (tag & TAG_IN) {
+          handle_inbound(tag & ~TAG_IN, flags);
+        } else if (tag & TAG_OUT) {
+          handle_outbound(tag & ~TAG_OUT, flags);
+        }
+      }
+      // Reconnect timers: disconnected reliable connections redial on
+      // their backoff schedule whether or not traffic is queued (the
+      // reference's keep_alive loop does the same).
+      for (auto& [key, c] : out_conns_) {
+        if (c.fd < 0 && c.reliable && c.next_retry_ms <= now) {
+          start_connect(c);
+        }
+      }
+    }
+  }
+
+  int next_timeout() {
+    uint64_t now = now_ms();
+    int64_t best = -1;
+    for (auto& [key, c] : out_conns_) {
+      if (c.fd < 0 && c.reliable) {
+        int64_t d = int64_t(c.next_retry_ms) - int64_t(now);
+        if (d < 0) d = 0;
+        if (best < 0 || d < best) best = d;
+      }
+    }
+    return int(best);
+  }
+
+  void run_commands() {
+    std::deque<Command> cmds;
+    {
+      std::lock_guard<std::mutex> g(cmd_mu_);
+      cmds.swap(commands_);
+    }
+    for (auto& c : cmds) {
+      switch (c.type) {
+        case CMD_STOP:
+          stop_ = true;
+          break;
+        case CMD_ADD_LISTENER: {
+          epoll_event ev{};
+          ev.events = EPOLLIN;
+          ev.data.u64 = TAG_LISTENER | c.id;
+          listener_fds_[c.id] = c.fd;
+          listener_autoack_[c.id] = c.flag;
+          epoll_ctl(epfd_, EPOLL_CTL_ADD, c.fd, &ev);
+          break;
+        }
+        case CMD_CLOSE_LISTENER: {
+          auto it = listener_fds_.find(c.id);
+          if (it != listener_fds_.end()) {
+            epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second, nullptr);
+            close(it->second);
+            listener_fds_.erase(it);
+          }
+          listener_autoack_.erase(c.id);
+          std::vector<uint64_t> doomed;
+          for (auto& [cid, conn] : in_conns_) {
+            if (conn.listener_id == c.id) doomed.push_back(cid);
+          }
+          for (uint64_t cid : doomed) {
+            auto cit = in_conns_.find(cid);
+            if (cit != in_conns_.end()) {
+              close(cit->second.fd);
+              in_conns_.erase(cit);
+            }
+          }
+          break;
+        }
+        case CMD_SEND_SIMPLE:
+          send_simple(c.host, c.port, c.payload);
+          break;
+        case CMD_SEND_RELIABLE:
+          send_reliable(c.host, c.port, c.id, c.payload);
+          break;
+        case CMD_CANCEL:
+          cancelled_.insert(c.id);
+          break;
+        case CMD_REPLY: {
+          auto it = in_conns_.find(c.id);
+          if (it != in_conns_.end() && !it->second.dead) {
+            frame_append(it->second.outbuf,
+                         reinterpret_cast<const uint8_t*>(c.payload.data()),
+                         uint32_t(c.payload.size()));
+            flush_inbound(it->second);
+          }
+          break;
+        }
+      }
+    }
+  }
+
+  // ---- inbound ----
+
+  void accept_all(uint64_t listener_id) {
+    int lfd = listener_fds_[listener_id];
+    while (true) {
+      int fd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK | SOCK_CLOEXEC);
+      if (fd < 0) break;
+      int one = 1;
+      setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+      uint64_t id = next_conn_id_++;
+      InConn& c = in_conns_[id];
+      c.fd = fd;
+      c.id = id;
+      c.listener_id = listener_id;
+      c.auto_ack = listener_autoack_[listener_id];
+      epoll_event ev{};
+      ev.events = EPOLLIN;
+      ev.data.u64 = TAG_IN | id;
+      epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    }
+  }
+
+  void drop_inbound(uint64_t id) {
+    auto it = in_conns_.find(id);
+    if (it == in_conns_.end()) return;
+    emit(Event{EV_GONE, it->second.listener_id, id, {}});
+    close(it->second.fd);
+    in_conns_.erase(it);
+  }
+
+  void handle_inbound(uint64_t id, uint32_t flags) {
+    auto it = in_conns_.find(id);
+    if (it == in_conns_.end()) return;
+    InConn& c = it->second;
+    if (flags & (EPOLLERR | EPOLLHUP)) {
+      drop_inbound(id);
+      return;
+    }
+    if (flags & EPOLLIN) {
+      char buf[64 * 1024];
+      while (true) {
+        ssize_t r = read(c.fd, buf, sizeof buf);
+        if (r > 0) {
+          c.inbuf.append(buf, size_t(r));
+        } else if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+          drop_inbound(id);
+          return;
+        } else {
+          break;
+        }
+      }
+      // Reassemble frames.
+      size_t off = 0;
+      while (c.inbuf.size() - off >= 4) {
+        const uint8_t* p = reinterpret_cast<const uint8_t*>(c.inbuf.data()) + off;
+        uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                       (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+        if (len > MAX_FRAME) {
+          drop_inbound(id);
+          return;
+        }
+        if (c.inbuf.size() - off - 4 < len) break;
+        emit(Event{EV_RECV, c.listener_id, id,
+                   c.inbuf.substr(off + 4, len)});
+        if (c.auto_ack) {
+          frame_append(c.outbuf, reinterpret_cast<const uint8_t*>("Ack"), 3);
+        }
+        off += 4 + len;
+      }
+      if (off) c.inbuf.erase(0, off);
+      if (!c.outbuf.empty()) {
+        flush_inbound(c);
+        return;  // flush_inbound may have dropped the connection
+      }
+    }
+    if (flags & EPOLLOUT) flush_inbound(c);
+  }
+
+  void flush_inbound(InConn& c) {
+    if (c.outbuf.size() > IN_OUTBUF_CAP) {
+      drop_inbound(c.id);  // peer reads nothing: hostile or dead
+      return;
+    }
+    while (!c.outbuf.empty()) {
+      ssize_t w = write(c.fd, c.outbuf.data(), c.outbuf.size());
+      if (w > 0) {
+        c.outbuf.erase(0, size_t(w));
+      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        break;
+      } else {
+        drop_inbound(c.id);
+        return;
+      }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN | (c.outbuf.empty() ? 0u : uint32_t(EPOLLOUT));
+    ev.data.u64 = TAG_IN | c.id;
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
+  }
+
+  // ---- outbound ----
+
+  OutConn& out_conn(const std::string& host, uint16_t port, bool reliable) {
+    AddrKey key{host, port, reliable};
+    auto it = out_conns_.find(key);
+    if (it == out_conns_.end()) {
+      uint64_t slot = next_out_slot_++;
+      OutConn& c = out_conns_[key];
+      c.key_hash = slot;
+      c.host = host;
+      c.port = port;
+      c.reliable = reliable;
+      out_by_slot_[slot] = key;
+      return c;
+    }
+    return it->second;
+  }
+
+  void send_simple(const std::string& host, uint16_t port,
+                   const std::string& payload) {
+    OutConn& c = out_conn(host, port, false);
+    if (c.pending.size() >= SIMPLE_QUEUE_CAP) return;  // best-effort drop
+    PendingMsg m;
+    m.msg_id = 0;
+    frame_append(m.frame, reinterpret_cast<const uint8_t*>(payload.data()),
+                 uint32_t(payload.size()));
+    c.pending.push_back(std::move(m));
+    if (c.fd < 0 && !c.connecting) start_connect(c);
+    if (c.fd >= 0 && !c.connecting) pump_out(c);
+  }
+
+  void send_reliable(const std::string& host, uint16_t port, uint64_t msg_id,
+                     const std::string& payload) {
+    OutConn& c = out_conn(host, port, true);
+    PendingMsg m;
+    m.msg_id = msg_id;
+    frame_append(m.frame, reinterpret_cast<const uint8_t*>(payload.data()),
+                 uint32_t(payload.size()));
+    c.pending.push_back(std::move(m));
+    if (c.fd < 0 && !c.connecting && c.next_retry_ms <= now_ms()) {
+      start_connect(c);
+    }
+    if (c.fd >= 0 && !c.connecting) pump_out(c);
+  }
+
+  void start_connect(OutConn& c) {
+    int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
+    if (fd < 0) {
+      conn_failed(c);
+      return;
+    }
+    int one = 1;
+    setsockopt(fd, IPPROTO_TCP, TCP_NODELAY, &one, sizeof one);
+    sockaddr_in addr{};
+    addr.sin_family = AF_INET;
+    addr.sin_port = htons(c.port);
+    if (inet_pton(AF_INET, c.host.c_str(), &addr.sin_addr) != 1) {
+      close(fd);
+      conn_failed(c);
+      return;
+    }
+    int r = connect(fd, reinterpret_cast<sockaddr*>(&addr), sizeof addr);
+    if (r < 0 && errno != EINPROGRESS) {
+      close(fd);
+      conn_failed(c);
+      return;
+    }
+    c.fd = fd;
+    c.connecting = (r < 0);
+    epoll_event ev{};
+    ev.events = EPOLLIN | EPOLLOUT;
+    ev.data.u64 = TAG_OUT | c.key_hash;
+    epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
+    if (!c.connecting) on_connected(c);
+  }
+
+  void on_connected(OutConn& c) {
+    c.connecting = false;
+    c.backoff_ms = RETRY_DELAY_MS;
+    if (c.reliable && !c.inflight.empty()) {
+      // Replay un-ACKed frames ahead of queued ones.
+      for (auto it = c.inflight.rbegin(); it != c.inflight.rend(); ++it) {
+        c.pending.push_front(std::move(*it));
+      }
+      c.inflight.clear();
+    }
+    c.outbuf.clear();
+    pump_out(c);
+  }
+
+  void conn_failed(OutConn& c) {
+    if (c.fd >= 0) {
+      epoll_ctl(epfd_, EPOLL_CTL_DEL, c.fd, nullptr);
+      close(c.fd);
+      c.fd = -1;
+    }
+    c.connecting = false;
+    c.outbuf.clear();
+    c.inbuf.clear();
+    if (c.reliable) {
+      c.next_retry_ms = now_ms() + uint64_t(c.backoff_ms);
+      c.backoff_ms = std::min(c.backoff_ms * 2, RETRY_CAP_MS);
+    } else {
+      // Best-effort: queued frames die with the connection. The entry
+      // stays in the map (bounded by distinct peer addresses) so callers
+      // holding a reference across this call never dangle; the next send
+      // reconnects through it.
+      c.pending.clear();
+    }
+  }
+
+  void pump_out(OutConn& c) {
+    // Move pending frames into the staging buffer (reliable: track order
+    // in inflight for ACK pairing), then write as much as the socket
+    // accepts.
+    while (!c.pending.empty() && c.outbuf.size() < 1 << 20) {
+      PendingMsg m = std::move(c.pending.front());
+      c.pending.pop_front();
+      if (m.msg_id && cancelled_.count(m.msg_id)) {
+        cancelled_.erase(m.msg_id);
+        continue;
+      }
+      c.outbuf += m.frame;
+      if (c.reliable) c.inflight.push_back(std::move(m));
+    }
+    while (!c.outbuf.empty()) {
+      ssize_t w = write(c.fd, c.outbuf.data(), c.outbuf.size());
+      if (w > 0) {
+        c.outbuf.erase(0, size_t(w));
+      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        break;
+      } else {
+        conn_failed(c);
+        return;
+      }
+    }
+    epoll_event ev{};
+    ev.events = EPOLLIN |
+                ((c.outbuf.empty() && c.pending.empty()) ? 0u : uint32_t(EPOLLOUT));
+    ev.data.u64 = TAG_OUT | c.key_hash;
+    epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
+  }
+
+  void handle_outbound(uint64_t slot, uint32_t flags) {
+    auto kit = out_by_slot_.find(slot);
+    if (kit == out_by_slot_.end()) return;
+    auto cit = out_conns_.find(kit->second);
+    if (cit == out_conns_.end()) return;
+    OutConn& c = cit->second;
+    if (c.connecting) {
+      int err = 0;
+      socklen_t len = sizeof err;
+      getsockopt(c.fd, SOL_SOCKET, SO_ERROR, &err, &len);
+      if (err != 0 || (flags & (EPOLLERR | EPOLLHUP))) {
+        conn_failed(c);
+        return;
+      }
+      on_connected(c);
+      return;
+    }
+    if (flags & (EPOLLERR | EPOLLHUP)) {
+      conn_failed(c);
+      return;
+    }
+    if (flags & EPOLLIN) {
+      char buf[16 * 1024];
+      while (true) {
+        ssize_t r = read(c.fd, buf, sizeof buf);
+        if (r > 0) {
+          if (c.reliable) {
+            c.inbuf.append(buf, size_t(r));
+          }  // simple: replies discarded
+        } else if (r == 0 ||
+                   (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
+          conn_failed(c);
+          return;
+        } else {
+          break;
+        }
+      }
+      if (c.reliable) {
+        size_t off = 0;
+        while (c.inbuf.size() - off >= 4) {
+          const uint8_t* p =
+              reinterpret_cast<const uint8_t*>(c.inbuf.data()) + off;
+          uint32_t len = (uint32_t(p[0]) << 24) | (uint32_t(p[1]) << 16) |
+                         (uint32_t(p[2]) << 8) | uint32_t(p[3]);
+          if (len > MAX_FRAME) {
+            conn_failed(c);
+            return;
+          }
+          if (c.inbuf.size() - off - 4 < len) break;
+          std::string ack = c.inbuf.substr(off + 4, len);
+          off += 4 + len;
+          // FIFO-pair with the oldest non-cancelled in-flight message
+          // (reference reliable_sender.rs ack_loop semantics).
+          while (!c.inflight.empty()) {
+            PendingMsg m = std::move(c.inflight.front());
+            c.inflight.pop_front();
+            if (cancelled_.count(m.msg_id)) {
+              cancelled_.erase(m.msg_id);
+              continue;
+            }
+            emit(Event{EV_ACKED, m.msg_id, 0, std::move(ack)});
+            break;
+          }
+        }
+        if (off) c.inbuf.erase(0, off);
+      }
+    }
+    if (flags & EPOLLOUT) pump_out(c);
+  }
+
+  int epfd_;
+  int cmd_efd_;
+  int out_efd_;
+  std::thread thread_;
+  bool stop_ = false;
+  std::atomic<bool> cmd_signaled_{false};
+  std::atomic<bool> out_signaled_{false};
+
+  std::mutex cmd_mu_;
+  std::deque<Command> commands_;
+
+  std::mutex ev_mu_;
+  std::deque<Event> events_;
+
+  uint64_t next_listener_id_ = 1;
+  uint64_t next_conn_id_ = 1;
+  uint64_t next_out_slot_ = 1;
+
+  std::unordered_map<uint64_t, int> listener_fds_;
+  std::unordered_map<uint64_t, bool> listener_autoack_;  // loop thread only
+  std::unordered_map<uint64_t, InConn> in_conns_;
+  std::unordered_map<AddrKey, OutConn, AddrKeyHash> out_conns_;
+  std::unordered_map<uint64_t, AddrKey> out_by_slot_;
+  std::unordered_set<uint64_t> cancelled_;
+};
+
+}  // namespace
+
+extern "C" {
+
+void* hs_net_create() { return new NetCore(); }
+
+void hs_net_destroy(void* ctx) { delete static_cast<NetCore*>(ctx); }
+
+int hs_net_event_fd(void* ctx) {
+  return static_cast<NetCore*>(ctx)->out_event_fd();
+}
+
+int64_t hs_net_listen(void* ctx, const char* host, uint16_t port,
+                      int auto_ack) {
+  return static_cast<NetCore*>(ctx)->listen_on(host, port, auto_ack != 0);
+}
+
+void hs_net_send(void* ctx, const char* host, uint16_t port,
+                 const uint8_t* data, uint32_t len, int reliable,
+                 uint64_t msg_id) {
+  Command c;
+  c.type = reliable ? CMD_SEND_RELIABLE : CMD_SEND_SIMPLE;
+  c.host = host;
+  c.port = port;
+  c.id = msg_id;
+  c.payload.assign(reinterpret_cast<const char*>(data), len);
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+void hs_net_close_listener(void* ctx, uint64_t listener_id) {
+  Command c;
+  c.type = CMD_CLOSE_LISTENER;
+  c.id = listener_id;
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+void hs_net_cancel(void* ctx, uint64_t msg_id) {
+  Command c;
+  c.type = CMD_CANCEL;
+  c.id = msg_id;
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+void hs_net_reply(void* ctx, uint64_t conn_id, const uint8_t* data,
+                  uint32_t len) {
+  Command c;
+  c.type = CMD_REPLY;
+  c.id = conn_id;
+  c.payload.assign(reinterpret_cast<const char*>(data), len);
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+int64_t hs_net_drain(void* ctx, uint8_t* buf, uint32_t cap) {
+  return static_cast<NetCore*>(ctx)->drain(buf, cap);
+}
+
+}  // extern "C"

@@ -51,18 +51,48 @@ class MessageHandler:
         raise NotImplementedError
 
 
-class Receiver:
-    """Listens on ``(host, port)``; spawns one runner task per connection."""
+class _AckedWriter:
+    """Writer handed to handlers on auto-ack receivers: the ACK already
+    went out when the frame was read, so the handler's own
+    ``writer.send(b"Ack")`` is a no-op (a second ACK would mispair the
+    sender's FIFO ACK accounting). Handlers only ever reply with the
+    literal ACK frame."""
 
-    def __init__(self, address: tuple[str, int], handler: MessageHandler) -> None:
+    __slots__ = ()
+
+    async def send(self, payload: bytes) -> None:
+        pass
+
+
+class Receiver:
+    """Listens on ``(host, port)``; spawns one runner task per connection.
+
+    With ``auto_ack`` the runner writes the ACK frame the moment a frame
+    is read, before dispatch — the sender's back-pressure signal means
+    "received", not "processed", exactly as the reference handlers that
+    ACK on their first line (``consensus.rs:144-153``,
+    ``mempool.rs:224-237``)."""
+
+    def __init__(
+        self,
+        address: tuple[str, int],
+        handler: MessageHandler,
+        auto_ack: bool = False,
+    ) -> None:
         self.address = address
         self.handler = handler
+        self.auto_ack = auto_ack
         self._server: asyncio.base_events.Server | None = None
         self._writers: set[asyncio.StreamWriter] = set()
 
     @classmethod
-    async def spawn(cls, address: tuple[str, int], handler: MessageHandler) -> "Receiver":
-        self = cls(address, handler)
+    async def spawn(
+        cls,
+        address: tuple[str, int],
+        handler: MessageHandler,
+        auto_ack: bool = False,
+    ) -> "Receiver":
+        self = cls(address, handler, auto_ack)
         host, port = address
         self._server = await asyncio.start_server(self._on_connection, host, port)
         log.debug("listening on %s:%d", host, port)
@@ -72,11 +102,18 @@ class Receiver:
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
         peer = writer.get_extra_info("peername")
-        framed = FramedWriter(writer)
+        framed = _AckedWriter() if self.auto_ack else FramedWriter(writer)
         self._writers.add(writer)
         try:
             while True:
                 frame = await read_frame(reader)
+                if self.auto_ack:
+                    write_frame(writer, b"Ack")
+                    # drain() keeps flow control: a peer that floods
+                    # frames but never reads its ACKs pauses this read
+                    # loop at the transport's high-water mark instead of
+                    # growing the write buffer without bound.
+                    await writer.drain()
                 await self.handler.dispatch(framed, frame)
         except (asyncio.IncompleteReadError, ConnectionError):
             pass  # peer went away — normal

@@ -1,0 +1,172 @@
+"""Native (C++ epoll) transport tests — the same behavioral contract as
+the asyncio suite (`tests/test_network.py`, modeled on the reference
+network crate tests), plus cross-implementation interop: the two
+transports share one wire format, so either side may be native.
+
+Skipped wholesale if the toolchain cannot build the library.
+"""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.network import MessageHandler
+from hotstuff_tpu.network import native as hsnative
+from hotstuff_tpu.network.receiver import (
+    Receiver as AsyncioReceiver,
+    read_frame,
+    write_frame,
+)
+from hotstuff_tpu.network.simple_sender import SimpleSender as AsyncioSimpleSender
+
+from .common import async_test, listener
+
+pytestmark = pytest.mark.skipif(
+    not hsnative.available(), reason="native transport toolchain unavailable"
+)
+
+BASE_PORT = 18200
+
+
+class _EchoHandler(MessageHandler):
+    def __init__(self):
+        self.received = []
+
+    async def dispatch(self, writer, message: bytes) -> None:
+        self.received.append(message)
+        await writer.send(b"Ack")
+
+
+@async_test
+async def test_native_receiver_dispatch_and_reply():
+    handler = _EchoHandler()
+    receiver = await hsnative.NativeReceiver.spawn(
+        ("127.0.0.1", BASE_PORT), handler
+    )
+    await asyncio.sleep(0.05)
+    reader, writer = await asyncio.open_connection("127.0.0.1", BASE_PORT)
+    write_frame(writer, b"hello")
+    await writer.drain()
+    assert await asyncio.wait_for(read_frame(reader), 5) == b"Ack"
+    write_frame(writer, b"again")
+    await writer.drain()
+    assert await asyncio.wait_for(read_frame(reader), 5) == b"Ack"
+    assert handler.received == [b"hello", b"again"]
+    writer.close()
+    await receiver.shutdown()
+
+
+@async_test
+async def test_native_simple_send_to_asyncio_listener():
+    port = BASE_PORT + 1
+    task = asyncio.create_task(listener(port, expected=b"payload"))
+    await asyncio.sleep(0.05)
+    sender = hsnative.NativeSimpleSender()
+    sender.send(("127.0.0.1", port), b"payload")
+    assert await asyncio.wait_for(task, 5) == b"payload"
+    sender.shutdown()
+
+
+@async_test
+async def test_native_reliable_send_resolves_with_ack():
+    port = BASE_PORT + 2
+    task = asyncio.create_task(listener(port, expected=b"important"))
+    await asyncio.sleep(0.05)
+    sender = hsnative.NativeReliableSender()
+    handler = await sender.send(("127.0.0.1", port), b"important")
+    assert await asyncio.wait_for(handler, 5) == b"Ack"
+    await task
+    sender.shutdown()
+
+
+@async_test
+async def test_native_reliable_broadcast():
+    ports = [BASE_PORT + 3 + i for i in range(3)]
+    tasks = [asyncio.create_task(listener(p, expected=b"bcast")) for p in ports]
+    await asyncio.sleep(0.05)
+    sender = hsnative.NativeReliableSender()
+    handlers = await sender.broadcast(
+        [("127.0.0.1", p) for p in ports], b"bcast"
+    )
+    acks = await asyncio.wait_for(asyncio.gather(*handlers), 5)
+    assert acks == [b"Ack"] * 3
+    await asyncio.gather(*tasks)
+    sender.shutdown()
+
+
+@async_test(timeout=90)
+async def test_native_reliable_retry_before_listener_exists():
+    """Reference reliable_sender_tests.rs:50-67: send first, listener
+    appears later, ACK still arrives (backoff reconnect + replay)."""
+    port = BASE_PORT + 10
+    sender = hsnative.NativeReliableSender()
+    handler = await sender.send(("127.0.0.1", port), b"patience")
+    await asyncio.sleep(0.5)  # let a few connect attempts fail
+    task = asyncio.create_task(listener(port, expected=b"patience"))
+    assert await asyncio.wait_for(handler, 30) == b"Ack"
+    await task
+    sender.shutdown()
+
+
+@async_test
+async def test_native_cancellation_skips_replay():
+    """A cancelled handler's message is not replayed once the peer comes
+    up: only the live message arrives."""
+    port = BASE_PORT + 11
+    sender = hsnative.NativeReliableSender()
+    doomed = await sender.send(("127.0.0.1", port), b"doomed")
+    await asyncio.sleep(0.2)
+    doomed.cancel()
+    live = await sender.send(("127.0.0.1", port), b"live")
+    await asyncio.sleep(0.1)
+
+    received = []
+
+    class Collect(MessageHandler):
+        async def dispatch(self, writer, message):
+            received.append(message)
+            await writer.send(b"Ack")
+
+    receiver = await AsyncioReceiver.spawn(("127.0.0.1", port), Collect())
+    assert await asyncio.wait_for(live, 30) == b"Ack"
+    assert received == [b"live"]
+    await receiver.shutdown()
+    sender.shutdown()
+
+
+@async_test
+async def test_asyncio_sender_to_native_receiver_interop():
+    """Wire compatibility the other way: the asyncio SimpleSender talks
+    to a native receiver."""
+    port = BASE_PORT + 12
+    handler = _EchoHandler()
+    receiver = await hsnative.NativeReceiver.spawn(("127.0.0.1", port), handler)
+    await asyncio.sleep(0.05)
+    sender = AsyncioSimpleSender()
+    sender.send(("127.0.0.1", port), b"cross")
+    await asyncio.sleep(0.3)
+    assert handler.received == [b"cross"]
+    sender.shutdown()
+    await receiver.shutdown()
+
+
+@async_test
+async def test_native_throughput_many_frames():
+    """Batched event delivery: thousands of small frames arrive intact
+    and in order per connection."""
+    port = BASE_PORT + 13
+    handler = _EchoHandler()
+    receiver = await hsnative.NativeReceiver.spawn(("127.0.0.1", port), handler)
+    await asyncio.sleep(0.05)
+    sender = hsnative.NativeSimpleSender()
+    n = 2000
+    for i in range(n):
+        sender.send(("127.0.0.1", port), b"m%06d" % i)
+    for _ in range(100):
+        await asyncio.sleep(0.05)
+        if len(handler.received) >= n:
+            break
+    assert len(handler.received) == n
+    assert handler.received == [b"m%06d" % i for i in range(n)]
+    sender.shutdown()
+    await receiver.shutdown()
