@@ -1,0 +1,102 @@
+"""Device vs host SHA-512 batch digesting (BASELINE config 3 decision).
+
+Measures the mempool Processor's two digest paths at a drain of K batches
+of S bytes each (the ``device_batch_digests`` opportunistic drain,
+``mempool/processor.py``): host hashlib per batch vs one batched device
+dispatch. Emits one line per configuration and a recommendation, appended
+to ``results/digest-bench-<backend>.txt`` with ``--output``.
+
+    python -m benchmark.digest_bench --output results
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import os
+import random
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from hotstuff_tpu.utils.jaxcache import enable_persistent_cache  # noqa: E402
+
+enable_persistent_cache()
+
+
+def bench(k: int, size: int, iters: int = 5) -> tuple[float, float]:
+    """Returns (host_s, device_s) to digest k batches of `size` bytes."""
+    from hotstuff_tpu.ops.sha512 import sha512_32_batch
+
+    rng = random.Random(42)
+    batches = [rng.randbytes(size) for _ in range(k)]
+
+    # Correctness first: the device path must match hashlib bit-for-bit.
+    dev = sha512_32_batch(batches)
+    host = [hashlib.sha512(b).digest()[:32] for b in batches]
+    assert list(dev) == host, "device SHA-512 diverges from hashlib"
+
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        [hashlib.sha512(b).digest()[:32] for b in batches]
+    host_s = (time.perf_counter() - t0) / iters
+
+    sha512_32_batch(batches)  # warm (compile cached)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        sha512_32_batch(batches)
+    device_s = (time.perf_counter() - t0) / iters
+    return host_s, device_s
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--output", help="directory to append the result file to")
+    p.add_argument("--sizes", default="512,15000,500000")
+    p.add_argument("--drains", default="8,32,128")
+    p.add_argument(
+        "--platform",
+        help="force a jax platform (e.g. cpu). NOTE: this environment "
+        "pins jax_platforms to the tunneled axon TPU plugin at "
+        "interpreter startup and the JAX_PLATFORMS env var does NOT "
+        "override it — only jax.config (set here, before backend init) "
+        "does.",
+    )
+    args = p.parse_args()
+
+    import jax
+
+    if args.platform:
+        jax.config.update("jax_platforms", args.platform)
+    backend = jax.default_backend()
+    lines = []
+    wins = 0
+    total = 0
+    for size in (int(s) for s in args.sizes.split(",")):
+        for k in (int(d) for d in args.drains.split(",")):
+            host_s, dev_s = bench(k, size)
+            total += 1
+            wins += dev_s < host_s
+            lines.append(
+                f"digest k={k} size={size}B backend={backend}: "
+                f"host {host_s * 1e3:.2f} ms, device {dev_s * 1e3:.2f} ms "
+                f"({host_s / dev_s:.2f}x)"
+            )
+            print(lines[-1], flush=True)
+    rec = (
+        "RECOMMEND device_batch_digests=True"
+        if wins > total / 2
+        else "RECOMMEND device_batch_digests=False (host hashing wins here)"
+    )
+    lines.append(f"{rec} [{wins}/{total} device wins]")
+    print(lines[-1])
+    if args.output:
+        os.makedirs(args.output, exist_ok=True)
+        path = os.path.join(args.output, f"digest-bench-{backend}.txt")
+        with open(path, "a") as f:
+            f.write("\n".join(lines) + "\n")
+
+
+if __name__ == "__main__":
+    main()

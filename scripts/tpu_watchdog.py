@@ -97,6 +97,19 @@ def capture_bench() -> bool:
     return False
 
 
+def capture_digests() -> bool:
+    """Device SHA-512 vs host hashlib at mempool drain rates (BASELINE
+    config 3's device_batch_digests decision) — only meaningful on real
+    hardware; the CPU-platform result (host wins) is already recorded."""
+    log("digest_bench on device ...")
+    rc, out = run(
+        [sys.executable, "-m", "benchmark.digest_bench", "--output", "results"],
+        timeout=1500,
+    )
+    log(f"digest_bench rc={rc} tail: {out.strip()[-300:]}")
+    return rc == 0
+
+
 def capture_committee() -> bool:
     ok = True
     sweeps = [
@@ -141,6 +154,7 @@ def main() -> None:
                 if not done:
                     bench_ok = capture_bench()
                     comm_ok = capture_committee()
+                    capture_digests()  # best-effort extra artifact
                     if bench_ok and comm_ok:
                         with open(DONE_MARKER, "w") as f:
                             f.write(
