@@ -1,0 +1,72 @@
+"""Diagnostic for the reputation-elector committee: boots 4-node
+committees repeatedly and, on a commit stall with advancing rounds (the
+"timeout grind" signature — proposals dying silently to the
+unsolicited-block gate while TCs keep rounds moving), dumps every
+node's election picks and anchored windows. Used to chase the rare
+(~1-in-20 pytest runs) residual liveness issue documented in ROADMAP.
+
+    python -m benchmark.diag_reputation
+"""
+
+import asyncio
+import logging
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+logging.basicConfig(level=logging.CRITICAL)
+from hotstuff_tpu.consensus import Authority, Committee, Consensus, Parameters
+from hotstuff_tpu.crypto import SignatureService, generate_keypair
+from hotstuff_tpu.store import Store
+
+async def run_once(run_idx):
+    n = 4
+    base = 27000 + (run_idx % 40) * 20
+    kps = [generate_keypair() for _ in range(n)]
+    committee = Committee(authorities={pk: Authority(stake=1, address=("127.0.0.1", base + i)) for i, (pk, _) in enumerate(kps)})
+    params = Parameters(timeout_delay=5_000, leader_elector="reputation")
+    engines, commits, sinks, cores = [], [], [], []
+    for pk, sk in kps:
+        rxm, txm, txc = asyncio.Queue(), asyncio.Queue(), asyncio.Queue()
+        async def drain(q=txm):
+            while True: await q.get()
+        sinks.append(asyncio.create_task(drain()))
+        eng = await Consensus.spawn(pk, committee, params, SignatureService(sk), Store(), rxm, txm, txc)
+        engines.append(eng); commits.append(txc)
+        cores.append(eng.tasks[0].get_coro().cr_frame.f_locals.get("self"))
+    names = {pk: f"n{i}" for i, (pk, _) in enumerate(kps)}
+    got = [0]*n
+    async def counter(i, q):
+        while True:
+            await q.get(); got[i] += 1
+    cnt = [asyncio.create_task(counter(i, q)) for i, q in enumerate(commits)]
+    grind = False
+    last = None
+    stall_ticks = 0
+    for t in range(60):
+        await asyncio.sleep(0.5)
+        if min(got) >= 12: break
+        state = tuple(got)
+        stall_ticks = stall_ticks + 1 if state == last else 0
+        last = state
+        if stall_ticks >= 12:  # 6s no commit anywhere but rounds moving?
+            grind = True
+            print(f"GRIND run={run_idx} commits={got} rounds={[c.round for c in cores]}")
+            for i, c in enumerate(cores):
+                el = c.leader_elector
+                r = c.round
+                picks = {rr: names.get(el.get_leader(rr), "?") for rr in range(r, r+4)}
+                win = [(e[0], names.get(e[1], "gen"), tuple(sorted(names.get(s,"?") for s in e[2]))) for e in el._window]
+                print(f"  n{i}: round={r} picks={picks}")
+                print(f"       window={win}")
+            break
+    print(f"run {run_idx}: commits={got} grind={grind}")
+    for e in engines: await e.shutdown()
+    for s in sinks + cnt: s.cancel()
+    return grind
+
+async def main():
+    for i in range(25):
+        if await run_once(i): break
+
+asyncio.run(main())

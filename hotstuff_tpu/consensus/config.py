@@ -31,6 +31,10 @@ class Parameters:
     # (byzantine signatures are identified and ejected on failure). Pairs
     # with the TPU crypto backend; worthwhile from ~100 validators.
     batch_vote_verification: bool = False
+    # "round-robin" (reference behavior) or "reputation" (DiemBFT-style
+    # active-set election: crashed validators stop being elected after
+    # the committed window rotates past them — see consensus/leader.py).
+    leader_elector: str = "round-robin"
 
     def log(self) -> None:
         # Picked up by the benchmark log parser (reference ``config.rs:25-31``).

@@ -19,7 +19,7 @@ from .config import Committee, Parameters
 from .core import Core
 from .errors import MalformedMessage
 from .helper import Helper
-from .leader import LeaderElector
+from .leader import make_elector
 from .mempool_driver import MempoolDriver
 from .messages import decode_message
 from .proposer import Proposer
@@ -99,7 +99,7 @@ class Consensus:
         )
         log.info("Node %s listening to consensus messages on %s", name, address)
 
-        leader_elector = LeaderElector(committee)
+        leader_elector = make_elector(committee, parameters.leader_elector)
         self.mempool_driver = MempoolDriver(store, tx_mempool, tx_loopback)
         self.synchronizer = Synchronizer(
             name, committee, store, tx_loopback, parameters.sync_retry_delay

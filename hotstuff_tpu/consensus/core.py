@@ -190,6 +190,9 @@ class Core:
                         # ``core.rs:145-149``).
                         log.info("Committed %s -> %s", blk, d)
             log.debug("Committed %r", blk)
+            # Committed blocks (in commit order) feed the elector's
+            # participation window (no-op for round-robin).
+            self.leader_elector.update(blk)
             await self.tx_commit.put(blk)
 
     def update_high_qc(self, qc: QC) -> None:
@@ -452,6 +455,17 @@ class Core:
         if block.round != self.round:
             return
 
+        # Leadership gate on the VOTE (the round-robin elector already
+        # rejected mismatches in handle_proposal; a lenient elector
+        # processes certificates above but never endorses an author its
+        # window says is not the leader).
+        if block.author != self.leader_elector.get_leader(block.round):
+            log.debug(
+                "Withholding vote for %r: author is not our expected leader",
+                block,
+            )
+            return
+
         vote = await self.make_vote(block)
         if vote is not None:
             log.debug("Created %r", vote)
@@ -465,15 +479,58 @@ class Core:
 
     async def handle_proposal(self, block: Block) -> None:
         digest = block.digest()
-        if block.author != self.leader_elector.get_leader(block.round):
-            raise WrongLeader(
-                f"block {digest} from {block.author} at round {block.round}"
-            )
+        # Redelivery short-circuit: helpers answer sync requests with
+        # ancestor CHAINS, so bursts can re-include blocks already fully
+        # processed (stored => verified, certificates applied, ancestry
+        # complete) or already SUSPENDED awaiting their parents.
+        # Re-verifying either is pure waste — at catch-up rates it was
+        # most of a straggler's CPU.
+        if await self.store.read(digest.data) is not None:
+            return
+        if self.synchronizer.is_pending(digest):
+            return
+        author_mismatch = block.author != self.leader_elector.get_leader(
+            block.round
+        )
+        if author_mismatch:
+            # Strict electors (round-robin) reject outright — all honest
+            # nodes share the same (stateless) leader function, so a
+            # mismatch is always a bad proposal. A LENIENT elector's
+            # leader opinion derives from the local committed window and
+            # can transiently diverge between honest nodes: still verify
+            # and process the certificates (QCs advance rounds and
+            # high_qc, healing the divergence) but store/vote only under
+            # the solicited-block rule below.
+            if not self.leader_elector.lenient:
+                raise WrongLeader(
+                    f"block {digest} from {block.author} at round {block.round}"
+                )
         n_sigs = 1 + len(block.qc.votes) + (len(block.tc.votes) if block.tc else 0)
         await verify_off_loop(block.verify, self.committee, n_sigs=n_sigs)
         await self.process_qc(block.qc)
         if block.tc is not None:
             await self.advance_round(block.tc.round)
+        if (
+            author_mismatch
+            and self.leader_elector.gate_active(block.round)
+            and not self.synchronizer.requested(digest)
+        ):
+            # Lenient mode, unsolicited mismatched author: certificates
+            # were applied above, but the block itself is NOT processed
+            # or stored. Solicited blocks (our own sync requests) are
+            # certified-chain ancestors and flow through — that is the
+            # divergence-healing path — while a byzantine member's
+            # fabricated blocks (valid signature, reused QC) can never
+            # grow the store. The gate lifts while the elector's window
+            # is EMPTY (boot/restart): such a node elects round-robin,
+            # disagrees with running peers by construction, and must
+            # commit their proposals to rebuild its window.
+            log.debug(
+                "Skipping unsolicited block %s from unexpected author %s",
+                digest,
+                block.author,
+            )
+            return
         if not await self.mempool_driver.verify(block):
             log.debug("Processing of %r suspended: missing payload", digest)
             return

@@ -17,6 +17,22 @@ from .messages import Block, encode_propose
 log = logging.getLogger("consensus")
 
 
+#: How many blocks a single SyncRequest reply may carry (the requested
+#: block + ancestors, NEWEST first — see the send loop for why). A
+#: straggler that missed a RANGE of blocks would otherwise walk backward
+#: one block per round trip (request parent -> reply -> discover
+#: grandparent missing -> request ...) — slower than a fast committee
+#: extends the chain, i.e. it never catches up. With chain replies each
+#: delivered ancestor suspends-and-requests the next synchronously, and
+#: once the deepest lands on stored ground the notify_read unwind
+#: re-delivers the whole suspended range: ~CHAIN_DEPTH rounds heal per
+#: RTT. Sized as a compromise: the common request is ONE lost block (the
+#: extra ancestors are redundant wire traffic, discarded by the
+#: requester's redelivery short-circuit), while a deep catch-up iterates
+#: frontier requests at one chain per RTT.
+CHAIN_DEPTH = 16
+
+
 class Helper:
     @classmethod
     def spawn(
@@ -37,7 +53,28 @@ class Helper:
                     data = await store.read(digest.data)
                     if data is not None:
                         block = Block.deserialize(data)
+                        # Send the requested block plus up to
+                        # CHAIN_DEPTH-1 ancestors, NEWEST FIRST: when
+                        # the requester processes the requested block it
+                        # suspends on the (missing) parent and registers
+                        # a sync request for it synchronously — before
+                        # the next reply frame is dequeued — so each
+                        # successive ancestor arrives already solicited
+                        # (the lenient leader path stores solicited
+                        # blocks only). The deepest delivered ancestor
+                        # lands on stored ground and the notify_read
+                        # unwind then re-delivers the whole suspended
+                        # range in order.
                         network.send(address, encode_propose(block))
+                        cur = block
+                        sent = 1
+                        while sent < CHAIN_DEPTH:
+                            pdata = await store.read(cur.parent().data)
+                            if pdata is None:
+                                break
+                            cur = Block.deserialize(pdata)
+                            network.send(address, encode_propose(cur))
+                            sent += 1
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:

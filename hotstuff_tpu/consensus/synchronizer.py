@@ -24,7 +24,6 @@ from .messages import Block, QC, encode_sync_request
 log = logging.getLogger("consensus")
 
 TIMER_ACCURACY = 5.0  # s (reference ``synchronizer.rs:22``)
-CHANNEL_CAPACITY = 1_000
 
 
 class Synchronizer:
@@ -42,7 +41,6 @@ class Synchronizer:
         self.tx_loopback = tx_loopback
         self.sync_retry_delay = sync_retry_delay / 1000.0
         self.network = SimpleSender()
-        self._inner: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
         self._pending: set[Digest] = set()  # block digests being waited on
         self._requests: dict[Digest, float] = {}  # parent digest -> first-request ts
         self._tasks: set[asyncio.Task] = set()
@@ -54,43 +52,78 @@ class Synchronizer:
         self._requests.pop(deliver.parent(), None)
         await self.tx_loopback.put(("loopback", deliver))
 
+    def _suspend(self, block: Block) -> None:
+        """Register the waiter + sync request for ``block``'s missing
+        parent. Runs SYNCHRONOUSLY inside ``get_parent_block`` (i.e. in
+        the Core's processing step): the solicited-block rule
+        (``requested``) must observe the registration before the Core
+        dequeues the next network frame, and on the inline-verification
+        CPU path there is no yield point between frames — a registration
+        deferred to a background task would race and misclassify helper
+        chain ancestors as unsolicited."""
+        digest = block.digest()
+        if digest in self._pending:
+            return
+        self._pending.add(digest)
+        parent = block.parent()
+        task = asyncio.create_task(self._waiter(parent, block))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+        if parent not in self._requests:
+            log.debug("requesting sync for block %s", parent)
+            self._requests[parent] = time.monotonic()
+            address = self.committee.address(block.author)
+            if address is not None:
+                self.network.send(
+                    address, encode_sync_request(parent, self.name)
+                )
+
     async def _run(self) -> None:
-        get_block = asyncio.create_task(self._inner.get())
-        timer = asyncio.create_task(asyncio.sleep(TIMER_ACCURACY))
         while True:
-            done, _ = await asyncio.wait(
-                {get_block, timer}, return_when=asyncio.FIRST_COMPLETED
+            await asyncio.sleep(TIMER_ACCURACY)
+            now = time.monotonic()
+            addresses = [
+                a for _, a in self.committee.broadcast_addresses(self.name)
+            ]
+            # Retry only the walk FRONTIERS (the newest few expired
+            # requests = the deepest missing ancestors): their chain
+            # replies (helpers serve ancestors in bulk) plus the
+            # notify_read unwind heal everything shallower.
+            # Rebroadcasting every outstanding request — one per
+            # missed round — floods the committee with O(gap)
+            # redeliveries per tick, which is exactly the storm that
+            # kept a straggler from ever catching up. A small K (not
+            # 1) covers independent missing chains (e.g. a fork from
+            # a view change) so none starves behind another's walk.
+            expired = sorted(
+                (
+                    (ts, digest)
+                    for digest, ts in self._requests.items()
+                    if ts + self.sync_retry_delay < now
+                ),
+                key=lambda e: e[0],
+                reverse=True,
             )
-            if get_block in done:
-                block: Block = get_block.result()
-                get_block = asyncio.create_task(self._inner.get())
-                digest = block.digest()
-                if digest not in self._pending:
-                    self._pending.add(digest)
-                    parent = block.parent()
-                    task = asyncio.create_task(self._waiter(parent, block))
-                    self._tasks.add(task)
-                    task.add_done_callback(self._tasks.discard)
-                    if parent not in self._requests:
-                        log.debug("requesting sync for block %s", parent)
-                        self._requests[parent] = time.monotonic()
-                        address = self.committee.address(block.author)
-                        if address is not None:
-                            self.network.send(
-                                address, encode_sync_request(parent, self.name)
-                            )
-            if timer in done:
-                timer = asyncio.create_task(asyncio.sleep(TIMER_ACCURACY))
-                now = time.monotonic()
-                addresses = [
-                    a for _, a in self.committee.broadcast_addresses(self.name)
-                ]
-                for digest, ts in self._requests.items():
-                    if ts + self.sync_retry_delay < now:
-                        log.debug("requesting sync for block %s (retry)", digest)
-                        self.network.broadcast(
-                            addresses, encode_sync_request(digest, self.name)
-                        )
+            for _, frontier in expired[:3]:
+                log.debug("requesting sync for block %s (retry)", frontier)
+                self.network.broadcast(
+                    addresses, encode_sync_request(frontier, self.name)
+                )
+
+    def is_pending(self, digest: Digest) -> bool:
+        """True if ``digest`` is a block already suspended awaiting its
+        ancestors (chain-reply redeliveries skip re-verification)."""
+        return digest in self._pending
+
+    def requested(self, digest: Digest) -> bool:
+        """True if ``digest`` is a block this node has actively asked a
+        peer for (an outstanding sync request). Used by the lenient
+        leader path: only solicited blocks may be stored from an
+        unexpected author — they are certified-chain members by
+        construction (we requested them as some received block's
+        ancestor), so a byzantine member cannot grow the store with
+        unsolicited fabrications."""
+        return digest in self._requests
 
     async def get_parent_block(self, block: Block) -> Block | None:
         """The parent if stored; None after scheduling a sync (reference
@@ -100,7 +133,7 @@ class Synchronizer:
         data = await self.store.read(block.parent().data)
         if data is not None:
             return Block.deserialize(data)
-        await self._inner.put(block)
+        self._suspend(block)
         return None
 
     async def get_ancestors(self, block: Block) -> tuple[Block, Block] | None:

@@ -9,6 +9,13 @@ BASELINE config-3 regime: at committee scale hundreds of peer batches
 arrive per round and the digest work is throughput-bound, not
 latency-bound. A lone batch (or any device failure) falls back to host
 hashing, so the flag can never lose digests.
+
+Default recommendation (measured, ``benchmark.digest_bench``): keep
+``device_digests=False`` unless running on real TPU hardware AND the
+mempool drains tens of batches per wakeup. On the CPU platform host
+hashlib wins by ~30x (``results/digest-bench-cpu.txt``: 0.89 ms host vs
+27.6 ms emulated-device for 32 x 15 kB); the hardware number is captured
+by ``scripts/tpu_watchdog.py`` when the TPU tunnel is up.
 """
 
 from __future__ import annotations

@@ -449,14 +449,20 @@ class NetCore {
       return;
     }
     if (flags & EPOLLIN) {
+      // Read everything available, REMEMBERING eof/error instead of
+      // acting on it: when a one-shot peer's final frame and its FIN
+      // coalesce into one epoll wake (routine on loopback), dropping
+      // the connection before parsing would silently discard that
+      // frame. Parse first, drop after.
+      bool conn_gone = false;
       char buf[64 * 1024];
       while (true) {
         ssize_t r = read(c.fd, buf, sizeof buf);
         if (r > 0) {
           c.inbuf.append(buf, size_t(r));
         } else if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
-          drop_inbound(id);
-          return;
+          conn_gone = true;
+          break;
         } else {
           break;
         }
@@ -480,6 +486,10 @@ class NetCore {
         off += 4 + len;
       }
       if (off) c.inbuf.erase(0, off);
+      if (conn_gone) {
+        drop_inbound(id);  // frames above were parsed first
+        return;
+      }
       if (!c.outbuf.empty()) {
         flush_inbound(c);
         return;  // flush_inbound may have dropped the connection
@@ -675,6 +685,13 @@ class NetCore {
       return;
     }
     if (flags & EPOLLIN) {
+      // As in handle_inbound: parse BEFORE acting on eof/error. A peer
+      // that writes its ACK and closes (one-shot servers; restarting
+      // nodes) routinely delivers data+FIN in one epoll wake on
+      // loopback — failing the connection first would discard the ACK,
+      // leave the message "un-ACKed", and replay it forever against a
+      // listener that no longer exists.
+      bool conn_gone = false;
       char buf[16 * 1024];
       while (true) {
         ssize_t r = read(c.fd, buf, sizeof buf);
@@ -684,8 +701,8 @@ class NetCore {
           }  // simple: replies discarded
         } else if (r == 0 ||
                    (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
-          conn_failed(c);
-          return;
+          conn_gone = true;
+          break;
         } else {
           break;
         }
@@ -718,6 +735,10 @@ class NetCore {
           }
         }
         if (off) c.inbuf.erase(0, off);
+      }
+      if (conn_gone) {
+        conn_failed(c);  // ACKs above were paired first
+        return;
       }
     }
     if (flags & EPOLLOUT) pump_out(c);

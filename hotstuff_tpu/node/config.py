@@ -169,6 +169,9 @@ class Parameters:
                     batch_vote_verification=bool(
                         c.get("batch_vote_verification", False)
                     ),
+                    leader_elector=str(
+                        c.get("leader_elector", "round-robin")
+                    ),
                 ),
                 MempoolParameters(
                     gc_depth=int(m.get("gc_depth", 50)),
@@ -187,6 +190,11 @@ class Parameters:
             "consensus": {
                 "timeout_delay": self.consensus.timeout_delay,
                 "sync_retry_delay": self.consensus.sync_retry_delay,
+                "persist_sync": self.consensus.persist_sync,
+                "batch_vote_verification": (
+                    self.consensus.batch_vote_verification
+                ),
+                "leader_elector": self.consensus.leader_elector,
             },
             "mempool": {
                 "gc_depth": self.mempool.gc_depth,
