@@ -177,6 +177,18 @@ class Core:
         while self.last_committed_round + 1 < parent.round:
             ancestor = await self.synchronizer.get_parent_block(parent)
             assert ancestor is not None, "committed block should have all ancestors"
+            if ancestor.round <= self.last_committed_round:
+                # Round GAP (view change abandoned the rounds between):
+                # the fetched ancestor is already committed. Appending it
+                # again would emit a duplicate commit downstream (double-
+                # counted by the benchmark log parser) and feed a
+                # duplicate entry into the reputation elector's window —
+                # whose content then depends on each node's individual
+                # commit batching, silently breaking the
+                # identical-prefix => identical-window agreement
+                # invariant (observed live as a permanent election
+                # disagreement: the "timeout grind").
+                break
             to_commit.append(ancestor)
             parent = ancestor
         self.last_committed_round = block.round

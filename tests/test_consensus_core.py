@@ -266,3 +266,68 @@ async def test_stale_timer_event_does_not_suppress_vote():
         t.cancel()
     node["task"].cancel()
     node["sync"].shutdown()
+
+
+@async_test
+async def test_commit_walk_never_recommits_across_round_gaps():
+    """After a view change abandons rounds, the commit walk must stop at
+    already-committed ancestors: re-appending one emits a duplicate
+    commit downstream (double-counted TPS in the log parser) and, with
+    the reputation elector, feeds batching-dependent duplicate entries
+    into the election window — observed live as a permanent election
+    disagreement ("timeout grind")."""
+    import asyncio as _a
+
+    from hotstuff_tpu.consensus.messages import QC, Block
+    from hotstuff_tpu.crypto import Signature
+
+    committee = consensus_committee(BASE + 170)
+    node = spawn_core(0, committee, timeout_delay=60_000)
+    await asyncio.sleep(0.05)  # let the core task start
+    core = node["task"].get_coro().cr_frame.f_locals["self"]
+
+    key_list = keys()
+    by_pk = dict(key_list)
+    sorted_pks = sorted(by_pk.keys())
+
+    def signed_block(round_, qc, payload=()):
+        author = sorted_pks[round_ % len(sorted_pks)]
+        return Block.new_from_key(
+            qc=qc, tc=None, author=author, round_=round_,
+            payload=list(payload), secret=by_pk[author],
+        )
+
+    def qc_over(block, round_):
+        qc = QC(hash=block.digest(), round=round_, votes=[])
+        qc.votes = [
+            (pk, Signature.new(qc.digest(), by_pk[pk])) for pk in sorted_pks[:3]
+        ]
+        return qc
+
+    # Chain with a round GAP: B1 <- B2 (commits B1) then the chain jumps
+    # B2 <- B4 <- B5 (rounds 3 abandoned by a "view change").
+    b1 = signed_block(1, QC.genesis())
+    b2 = signed_block(2, qc_over(b1, 1))
+    b4 = signed_block(4, qc_over(b2, 2))
+    b5 = signed_block(5, qc_over(b4, 4))
+    for b in (b1, b2, b4):
+        await core.store_block(b)
+
+    commits = []
+
+    async def drain():
+        while True:
+            commits.append(await node["commit"].get())
+
+    drainer = _a.create_task(drain())
+    # Commit B2 first (last_committed=2), then B5: the walk fetches B4
+    # (uncommitted, round 4 > 2) and then B2 — whose round equals
+    # last_committed — which must NOT be re-emitted.
+    await core.commit(b2)
+    await core.commit(b5)
+    await _a.sleep(0.1)
+    rounds = [b.round for b in commits]
+    assert rounds == sorted(set(rounds)), f"duplicate commits: {rounds}"
+    drainer.cancel()
+    node["task"].cancel()
+    node["sync"].shutdown()
