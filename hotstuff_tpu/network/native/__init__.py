@@ -24,9 +24,11 @@ from __future__ import annotations
 
 import asyncio
 import ctypes
+import ipaddress
 import logging
 import os
 import random
+import socket
 import struct
 import subprocess
 
@@ -37,6 +39,17 @@ _SRC = os.path.join(_DIR, "netcore.cpp")
 _LIB = os.path.join(_DIR, "libhsnet.so")
 
 PENDING_CAP = 1_000  # live reliable messages per peer before back-pressure
+# Per-listener budget of frames emitted by the C++ loop but not yet
+# dispatched by Python: past HIGH the loop stops reading the listener's
+# sockets (kernel-buffer back-pressure reaches the peer, like the asyncio
+# receiver's one-frame-per-dispatch bound); once dispatch progress brings
+# it back to LOW it resumes. Enforced loop-side because a local flood is
+# fully in the kernel before the Python loop even runs. Bounds
+# Python-side memory against a flooding peer; read at spawn time.
+RECV_HIGH_WATER = 4_096
+RECV_LOW_WATER = 512
+# Dispatch-progress report granularity (frames per hs_net_consumed call).
+_CONSUMED_BATCH = 32
 
 _EV_RECV = 1
 _EV_ACKED = 2
@@ -78,7 +91,12 @@ def _load():
         lib.hs_net_event_fd.argtypes = [ctypes.c_void_p]
         lib.hs_net_listen.restype = ctypes.c_int64
         lib.hs_net_listen.argtypes = [
-            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint16, ctypes.c_int,
+            ctypes.c_uint32, ctypes.c_uint32,
+        ]
+        lib.hs_net_consumed.restype = None
+        lib.hs_net_consumed.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
         ]
         lib.hs_net_close_listener.restype = None
         lib.hs_net_close_listener.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -89,6 +107,10 @@ def _load():
         ]
         lib.hs_net_cancel.restype = None
         lib.hs_net_cancel.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
+        lib.hs_net_pause_listener.restype = None
+        lib.hs_net_pause_listener.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_int
+        ]
         lib.hs_net_reply.restype = None
         lib.hs_net_reply.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32
@@ -96,6 +118,10 @@ def _load():
         lib.hs_net_drain.restype = ctypes.c_int64
         lib.hs_net_drain.argtypes = [
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32
+        ]
+        lib.hs_net_stats.restype = None
+        lib.hs_net_stats.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)
         ]
         _lib = lib
     return _lib
@@ -120,6 +146,11 @@ class NativeTransport:
         # listener_id -> (queue of (conn_id, frame), dispatch task owner)
         self._listeners: dict[int, "NativeReceiver"] = {}
         self._acks: dict[int, asyncio.Future] = {}
+        # Bumped whenever the reader rebinds to a new event loop: senders
+        # compare against it to reset per-peer back-pressure counters
+        # whose futures were dropped with the old loop.
+        self.generation = 0
+        self._resolved: dict[str, str] = {}  # hostname -> IPv4 literal
 
     @classmethod
     def get(cls) -> "NativeTransport":
@@ -133,9 +164,20 @@ class NativeTransport:
         loop = asyncio.get_running_loop()
         if self._loop is loop:
             return
+        prev = self._loop
+        if prev is not None and not prev.is_closed():
+            try:
+                prev.remove_reader(self._efd)
+            except Exception:  # noqa: BLE001 — loop may be tearing down
+                pass
         # A previous loop is gone (tests): its futures can never be
-        # awaited again. Drop them so ACK events for them are ignored.
+        # awaited again. Cancel their ids in the C++ layer — otherwise the
+        # orphaned inflight entries would FIFO-consume ACKs meant for new
+        # messages on the same connection — and drop them here.
+        for mid in self._acks:
+            self._lib.hs_net_cancel(self._ctx, ctypes.c_uint64(mid))
         self._acks.clear()
+        self.generation += 1
         self._loop = loop
         loop.add_reader(self._efd, self._on_events)
 
@@ -146,28 +188,96 @@ class NativeTransport:
         self._next_msg_id += 1
         return mid
 
+    def _resolve(self, host: str) -> str | None:
+        """IPv4 literal for ``host`` (the C++ loop speaks inet_pton only).
+
+        Hostnames are resolved once and cached — committee files name a
+        small fixed peer set, so at most one blocking getaddrinfo per
+        distinct name per process (same lookup the asyncio transport does
+        inside ``open_connection``, which silently diverged before).
+        Unresolvable names fail loudly instead of retrying forever."""
+        if host in self._resolved:  # negative results cached as None
+            return self._resolved[host]
+        try:
+            ipaddress.IPv4Address(host)
+            self._resolved[host] = host
+            return host
+        except ValueError:
+            pass
+        try:
+            infos = socket.getaddrinfo(
+                host, None, socket.AF_INET, socket.SOCK_STREAM
+            )
+            addr = infos[0][4][0]
+        except OSError as exc:
+            # Cache the failure too: without it every send to the bad
+            # name would re-run a BLOCKING getaddrinfo on the event-loop
+            # thread, stalling consensus for the DNS timeout each round.
+            log.warning(
+                "native transport cannot resolve %r (%s): "
+                "dropping all sends to it for this process", host, exc,
+            )
+            self._resolved[host] = None
+            return None
+        self._resolved[host] = addr
+        return addr
+
     def listen(
         self, receiver: "NativeReceiver", host: str, port: int, auto_ack: bool
     ) -> int:
+        resolved = self._resolve(host)
+        if resolved is None:
+            raise OSError(f"cannot resolve listen address {host!r}")
         lid = self._lib.hs_net_listen(
-            self._ctx, host.encode(), ctypes.c_uint16(port), int(auto_ack)
+            self._ctx, resolved.encode(), ctypes.c_uint16(port),
+            int(auto_ack),
+            ctypes.c_uint32(RECV_HIGH_WATER), ctypes.c_uint32(RECV_LOW_WATER),
         )
         if lid < 0:
             raise OSError(-lid, os.strerror(-lid))
         self._listeners[lid] = receiver
         return lid
 
+    def consumed(self, lid: int, n: int) -> None:
+        self._lib.hs_net_consumed(
+            self._ctx, ctypes.c_uint64(lid), ctypes.c_uint64(n)
+        )
+
     def close_listener(self, lid: int) -> None:
         self._listeners.pop(lid, None)
         self._lib.hs_net_close_listener(self._ctx, ctypes.c_uint64(lid))
+
+    def pause_listener(self, lid: int, paused: bool) -> None:
+        self._lib.hs_net_pause_listener(
+            self._ctx, ctypes.c_uint64(lid), int(paused)
+        )
+
+    def stats(self) -> dict[str, int]:
+        """Loop-thread state snapshot (tests / operational visibility)."""
+        out = (ctypes.c_uint64 * 5)()
+        self._lib.hs_net_stats(self._ctx, out)
+        return {
+            "pending": out[0],
+            "inflight": out[1],
+            "cancelled": out[2],
+            "out_conns": out[3],
+            "in_conns": out[4],
+        }
 
     def send(
         self, address: tuple[str, int], data: bytes,
         reliable: bool = False, msg_id: int = 0,
     ) -> None:
         host, port = address
+        resolved = self._resolve(host)
+        if resolved is None:
+            # Logged by _resolve. Observable behavior matches a
+            # permanently-down peer (the asyncio transport's retry-forever
+            # case): the ACK future stays pending until the caller drops
+            # it, which cancels and reclaims the back-pressure slot.
+            return
         self._lib.hs_net_send(
-            self._ctx, host.encode(), ctypes.c_uint16(port),
+            self._ctx, resolved.encode(), ctypes.c_uint16(port),
             data, len(data), int(reliable), ctypes.c_uint64(msg_id),
         )
 
@@ -274,7 +384,13 @@ class NativeReceiver:
 
     async def _dispatch_loop(self) -> None:
         acked = _AckedWriter()
+        undisclosed = 0  # dispatched frames not yet reported to the loop
         while True:
+            if undisclosed and (
+                undisclosed >= _CONSUMED_BATCH or self._queue.empty()
+            ):
+                self._transport.consumed(self._lid, undisclosed)
+                undisclosed = 0
             conn_id, frame = await self._queue.get()
             writer = (
                 acked if self.auto_ack
@@ -284,6 +400,7 @@ class NativeReceiver:
                 await self.handler.dispatch(writer, frame)
             except Exception:
                 log.exception("handler error (native receiver %s)", self.address)
+            undisclosed += 1
 
     async def shutdown(self) -> None:
         if self._task is not None:
@@ -328,6 +445,7 @@ class NativeReliableSender:
         self._rng = random.Random()
         self._live: dict[tuple[str, int], int] = {}
         self._capacity: dict[tuple[str, int], asyncio.Event] = {}
+        self._generation = -1  # transport loop generation of the counters
 
     def _cap_event(self, address: tuple[str, int]) -> asyncio.Event:
         ev = self._capacity.get(address)
@@ -339,6 +457,14 @@ class NativeReliableSender:
 
     async def send(self, address: tuple[str, int], data: bytes):
         transport = NativeTransport.get()
+        if self._generation != transport.generation:
+            # The transport rebound to a new event loop and dropped our
+            # in-flight futures (their done-callbacks can never run on
+            # the dead loop): rebuild the back-pressure state so orphaned
+            # messages don't consume PENDING_CAP capacity forever.
+            self._generation = transport.generation
+            self._live.clear()
+            self._capacity.clear()
         ev = self._cap_event(address)
         while self._live.get(address, 0) >= PENDING_CAP:
             ev.clear()
@@ -350,7 +476,7 @@ class NativeReliableSender:
         self._live[address] = self._live.get(address, 0) + 1
 
         def on_done(fut: asyncio.Future, *, _addr=address, _mid=msg_id) -> None:
-            self._live[_addr] -= 1
+            self._live[_addr] = max(0, self._live.get(_addr, 0) - 1)
             if self._live[_addr] < PENDING_CAP:
                 self._cap_event(_addr).set()
             if fut.cancelled():

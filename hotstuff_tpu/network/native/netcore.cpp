@@ -37,6 +37,7 @@
 #include <unistd.h>
 
 #include <atomic>
+#include <condition_variable>
 #include <cstdint>
 #include <deque>
 #include <memory>
@@ -51,6 +52,12 @@ namespace {
 
 constexpr uint32_t MAX_FRAME = 64u * 1024u * 1024u;
 constexpr size_t SIMPLE_QUEUE_CAP = 1000;   // frames; matches Python sender
+// Per-wake read cap for inbound connections: without it one flooding
+// peer's handle_inbound drains its entire kernel buffer in a single
+// epoll round, starving other connections and letting a flood blow past
+// the listener-pause back-pressure before the pause command is serviced.
+// Level-triggered epoll re-fires for the remainder.
+constexpr size_t READ_BATCH_CAP = 256 * 1024;
 constexpr int RETRY_DELAY_MS = 200;
 constexpr int RETRY_CAP_MS = 60000;
 
@@ -87,6 +94,23 @@ enum : uint8_t {
   CMD_ADD_LISTENER = 5,  // listener fd already bound+listening
   CMD_STOP = 6,
   CMD_CLOSE_LISTENER = 7,  // close listener + its inbound connections
+  CMD_PAUSE_LISTENER = 8,  // stop reading inbound conns (back-pressure)
+  CMD_RESUME_LISTENER = 9,
+  CMD_STATS = 10,  // fill a StatsReq on the loop thread (tests/ops)
+  CMD_CONSUMED = 11,  // Python dispatched n frames of a listener
+};
+
+// Loop-thread state snapshot, serviced as a command so no lock covers the
+// hot maps. The requesting thread blocks until the loop fills it.
+struct StatsReq {
+  std::mutex mu;
+  std::condition_variable cv;
+  bool done = false;
+  uint64_t pending = 0;    // frames queued, not yet written
+  uint64_t inflight = 0;   // written, awaiting ACK
+  uint64_t cancelled = 0;  // parked cancel markers
+  uint64_t out_conns = 0;
+  uint64_t in_conns = 0;
 };
 
 struct Command {
@@ -96,6 +120,8 @@ struct Command {
   uint64_t id = 0;  // msg_id / conn_id / listener_id
   int fd = -1;
   bool flag = false;  // ADD_LISTENER: auto_ack
+  uint64_t count = 0;   // CONSUMED: frames; ADD_LISTENER: high<<32|low
+  void* ptr = nullptr;  // STATS: StatsReq*
   std::string payload;
 };
 
@@ -112,6 +138,7 @@ struct InConn {
   std::string outbuf;  // replies (ACKs)
   bool auto_ack = false;
   bool dead = false;
+  bool paused = false;  // reads suspended; kernel buffer back-pressures peer
 };
 
 struct PendingMsg {
@@ -135,6 +162,21 @@ struct OutConn {
   std::deque<PendingMsg> inflight;
   int backoff_ms = RETRY_DELAY_MS;
   uint64_t next_retry_ms = 0;  // 0 = connect now
+};
+
+struct Listener {
+  int fd = -1;
+  bool auto_ack = false;
+  bool cmd_paused = false;    // explicit hs_net_pause_listener
+  bool flood_paused = false;  // outstanding-event budget exceeded
+  // EV_RECV events emitted but not yet reported dispatched by Python.
+  // The budget must live HERE, not in Python: the sender writes to the
+  // kernel synchronously, so a flood is fully read and emitted before
+  // the Python loop ever runs — a Python-side pause is always too late.
+  uint64_t outstanding = 0;
+  uint32_t high = 0;  // 0 = unbounded (no budget)
+  uint32_t low = 0;
+  bool paused() const { return cmd_paused || flood_paused; }
 };
 
 struct AddrKey {
@@ -178,7 +220,7 @@ class NetCore {
     for (auto& [k, c] : out_conns_) {
       if (c.fd >= 0) close(c.fd);
     }
-    for (auto& [id, fd] : listener_fds_) close(fd);
+    for (auto& [id, l] : listeners_) close(l.fd);
     close(epfd_);
     close(cmd_efd_);
     close(out_efd_);
@@ -192,7 +234,8 @@ class NetCore {
   // back-pressure signal no longer waits for the receiving PROCESS to be
   // scheduled (handlers ACK before processing anyway, so semantics
   // match; reference consensus.rs:144-153, mempool.rs:224-237).
-  int64_t listen_on(const char* host, uint16_t port, bool auto_ack) {
+  int64_t listen_on(const char* host, uint16_t port, bool auto_ack,
+                    uint32_t high_water, uint32_t low_water) {
     int fd = socket(AF_INET, SOCK_STREAM | SOCK_NONBLOCK | SOCK_CLOEXEC, 0);
     if (fd < 0) return -errno;
     int one = 1;
@@ -216,6 +259,7 @@ class NetCore {
     c.fd = fd;
     c.id = id;
     c.flag = auto_ack;
+    c.count = (uint64_t(high_water) << 32) | uint64_t(low_water);
     push_cmd(std::move(c));
     return int64_t(id);
   }
@@ -361,19 +405,21 @@ class NetCore {
           epoll_event ev{};
           ev.events = EPOLLIN;
           ev.data.u64 = TAG_LISTENER | c.id;
-          listener_fds_[c.id] = c.fd;
-          listener_autoack_[c.id] = c.flag;
+          Listener& l = listeners_[c.id];
+          l.fd = c.fd;
+          l.auto_ack = c.flag;
+          l.high = uint32_t(c.count >> 32);
+          l.low = uint32_t(c.count & 0xffffffffu);
           epoll_ctl(epfd_, EPOLL_CTL_ADD, c.fd, &ev);
           break;
         }
         case CMD_CLOSE_LISTENER: {
-          auto it = listener_fds_.find(c.id);
-          if (it != listener_fds_.end()) {
-            epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second, nullptr);
-            close(it->second);
-            listener_fds_.erase(it);
+          auto it = listeners_.find(c.id);
+          if (it != listeners_.end()) {
+            epoll_ctl(epfd_, EPOLL_CTL_DEL, it->second.fd, nullptr);
+            close(it->second.fd);
+            listeners_.erase(it);
           }
-          listener_autoack_.erase(c.id);
           std::vector<uint64_t> doomed;
           for (auto& [cid, conn] : in_conns_) {
             if (conn.listener_id == c.id) doomed.push_back(cid);
@@ -393,9 +439,82 @@ class NetCore {
         case CMD_SEND_RELIABLE:
           send_reliable(c.host, c.port, c.id, c.payload);
           break;
-        case CMD_CANCEL:
-          cancelled_.insert(c.id);
+        case CMD_CANCEL: {
+          // Reclaim immediately instead of parking the id: queued frames
+          // for a permanently-down peer are only pruned in pump_out,
+          // which never runs while disconnected — meanwhile the Python
+          // side releases its back-pressure slot on cancellation and
+          // keeps queueing, so pending/cancelled_ would grow without
+          // bound (one proposal+vote per round per crashed peer). Erase
+          // the frame from every pending queue now; only messages
+          // already WRITTEN on a live socket (inflight) still need the
+          // cancelled_ marker for FIFO ACK pairing. A cancel racing an
+          // already-drained ACK matches neither and is dropped outright.
+          // msg_ids are unique: stop at the first hit (found in pending
+          // implies not inflight and vice versa).
+          bool found_pending = false;
+          bool still_inflight = false;
+          for (auto& [key, oc] : out_conns_) {
+            if (!oc.reliable) continue;
+            for (auto it = oc.pending.begin(); it != oc.pending.end(); ++it) {
+              if (it->msg_id == c.id) {
+                oc.pending.erase(it);
+                found_pending = true;
+                break;
+              }
+            }
+            if (found_pending) break;
+            for (auto& m : oc.inflight) {
+              if (m.msg_id == c.id) {
+                still_inflight = true;
+                break;
+              }
+            }
+            if (still_inflight) break;
+          }
+          if (still_inflight) cancelled_.insert(c.id);
           break;
+        }
+        case CMD_PAUSE_LISTENER:
+        case CMD_RESUME_LISTENER: {
+          auto it = listeners_.find(c.id);
+          if (it != listeners_.end()) {
+            it->second.cmd_paused = (c.type == CMD_PAUSE_LISTENER);
+            apply_listener_pause(c.id, it->second);
+          }
+          break;
+        }
+        case CMD_CONSUMED: {
+          auto it = listeners_.find(c.id);
+          if (it != listeners_.end()) {
+            Listener& l = it->second;
+            l.outstanding -= std::min(l.outstanding, c.count);
+            if (l.flood_paused && l.outstanding <= l.low) {
+              l.flood_paused = false;
+              apply_listener_pause(c.id, l);
+            }
+          }
+          break;
+        }
+        case CMD_STATS: {
+          auto* s = static_cast<StatsReq*>(c.ptr);
+          for (auto& [key, oc] : out_conns_) {
+            s->pending += oc.pending.size();
+            s->inflight += oc.inflight.size();
+          }
+          s->cancelled = cancelled_.size();
+          s->out_conns = out_conns_.size();
+          s->in_conns = in_conns_.size();
+          {
+            // notify under the lock: after the unlock the waiter may
+            // (spurious wakeup) observe done and destroy the
+            // stack-allocated request, leaving notify_one dangling.
+            std::lock_guard<std::mutex> g(s->mu);
+            s->done = true;
+            s->cv.notify_one();
+          }
+          break;
+        }
         case CMD_REPLY: {
           auto it = in_conns_.find(c.id);
           if (it != in_conns_.end() && !it->second.dead) {
@@ -412,8 +531,28 @@ class NetCore {
 
   // ---- inbound ----
 
+  // Sync every inbound connection's epoll interest with the listener's
+  // effective pause state. While paused no socket is read, so the kernel
+  // buffer fills and TCP flow control reaches the peer — the same bound
+  // the asyncio receiver gets from reading one frame per dispatch.
+  // Level-triggered epoll re-fires EPOLLIN on resume for buffered bytes.
+  void apply_listener_pause(uint64_t listener_id, Listener& l) {
+    bool pause = l.paused();
+    for (auto& [cid, conn] : in_conns_) {
+      if (conn.listener_id != listener_id || conn.paused == pause) continue;
+      conn.paused = pause;
+      epoll_event ev{};
+      ev.events = (pause ? 0u : uint32_t(EPOLLIN)) |
+                  (conn.outbuf.empty() ? 0u : uint32_t(EPOLLOUT));
+      ev.data.u64 = TAG_IN | cid;
+      epoll_ctl(epfd_, EPOLL_CTL_MOD, conn.fd, &ev);
+    }
+  }
+
   void accept_all(uint64_t listener_id) {
-    int lfd = listener_fds_[listener_id];
+    auto lit = listeners_.find(listener_id);
+    if (lit == listeners_.end()) return;
+    int lfd = lit->second.fd;
     while (true) {
       int fd = accept4(lfd, nullptr, nullptr, SOCK_NONBLOCK | SOCK_CLOEXEC);
       if (fd < 0) break;
@@ -424,9 +563,10 @@ class NetCore {
       c.fd = fd;
       c.id = id;
       c.listener_id = listener_id;
-      c.auto_ack = listener_autoack_[listener_id];
+      c.auto_ack = lit->second.auto_ack;
+      c.paused = lit->second.paused();
       epoll_event ev{};
-      ev.events = EPOLLIN;
+      ev.events = c.paused ? 0u : uint32_t(EPOLLIN);
       ev.data.u64 = TAG_IN | id;
       epoll_ctl(epfd_, EPOLL_CTL_ADD, fd, &ev);
     }
@@ -456,10 +596,12 @@ class NetCore {
       // frame. Parse first, drop after.
       bool conn_gone = false;
       char buf[64 * 1024];
-      while (true) {
+      size_t got = 0;
+      while (got < READ_BATCH_CAP) {
         ssize_t r = read(c.fd, buf, sizeof buf);
         if (r > 0) {
           c.inbuf.append(buf, size_t(r));
+          got += size_t(r);
         } else if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
           conn_gone = true;
           break;
@@ -467,7 +609,14 @@ class NetCore {
           break;
         }
       }
-      // Reassemble frames.
+      // Reassemble frames, charging each against the listener's
+      // outstanding-event budget: past high-water, reads stop until
+      // Python reports dispatch progress (CMD_CONSUMED). Frames already
+      // buffered in inbuf still parse — the bound is high + one read
+      // batch, never the whole flood.
+      Listener* l = nullptr;
+      auto lit = listeners_.find(c.listener_id);
+      if (lit != listeners_.end()) l = &lit->second;
       size_t off = 0;
       while (c.inbuf.size() - off >= 4) {
         const uint8_t* p = reinterpret_cast<const uint8_t*>(c.inbuf.data()) + off;
@@ -484,6 +633,13 @@ class NetCore {
           frame_append(c.outbuf, reinterpret_cast<const uint8_t*>("Ack"), 3);
         }
         off += 4 + len;
+        if (l != nullptr && l->high != 0) {
+          l->outstanding++;
+          if (!l->flood_paused && l->outstanding >= l->high) {
+            l->flood_paused = true;
+            apply_listener_pause(c.listener_id, *l);
+          }
+        }
       }
       if (off) c.inbuf.erase(0, off);
       if (conn_gone) {
@@ -515,7 +671,8 @@ class NetCore {
       }
     }
     epoll_event ev{};
-    ev.events = EPOLLIN | (c.outbuf.empty() ? 0u : uint32_t(EPOLLOUT));
+    ev.events = (c.paused ? 0u : uint32_t(EPOLLIN)) |
+                (c.outbuf.empty() ? 0u : uint32_t(EPOLLOUT));
     ev.data.u64 = TAG_IN | c.id;
     epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
   }
@@ -620,6 +777,15 @@ class NetCore {
     c.outbuf.clear();
     c.inbuf.clear();
     if (c.reliable) {
+      // FIFO pairing on this socket is over: cancelled inflight messages
+      // need neither replay nor their cancelled_ marker.
+      for (auto it = c.inflight.begin(); it != c.inflight.end();) {
+        if (it->msg_id != 0 && cancelled_.erase(it->msg_id)) {
+          it = c.inflight.erase(it);
+        } else {
+          ++it;
+        }
+      }
       c.next_retry_ms = now_ms() + uint64_t(c.backoff_ms);
       c.backoff_ms = std::min(c.backoff_ms * 2, RETRY_CAP_MS);
     } else {
@@ -762,8 +928,7 @@ class NetCore {
   uint64_t next_conn_id_ = 1;
   uint64_t next_out_slot_ = 1;
 
-  std::unordered_map<uint64_t, int> listener_fds_;
-  std::unordered_map<uint64_t, bool> listener_autoack_;  // loop thread only
+  std::unordered_map<uint64_t, Listener> listeners_;  // loop thread only
   std::unordered_map<uint64_t, InConn> in_conns_;
   std::unordered_map<AddrKey, OutConn, AddrKeyHash> out_conns_;
   std::unordered_map<uint64_t, AddrKey> out_by_slot_;
@@ -782,9 +947,22 @@ int hs_net_event_fd(void* ctx) {
   return static_cast<NetCore*>(ctx)->out_event_fd();
 }
 
+// high_water/low_water bound the listener's emitted-but-undispatched
+// event count (0 = unbounded): past high the loop stops reading the
+// listener's sockets until hs_net_consumed reports progress below low.
 int64_t hs_net_listen(void* ctx, const char* host, uint16_t port,
-                      int auto_ack) {
-  return static_cast<NetCore*>(ctx)->listen_on(host, port, auto_ack != 0);
+                      int auto_ack, uint32_t high_water,
+                      uint32_t low_water) {
+  return static_cast<NetCore*>(ctx)->listen_on(host, port, auto_ack != 0,
+                                               high_water, low_water);
+}
+
+void hs_net_consumed(void* ctx, uint64_t listener_id, uint64_t n) {
+  Command c;
+  c.type = CMD_CONSUMED;
+  c.id = listener_id;
+  c.count = n;
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
 }
 
 void hs_net_send(void* ctx, const char* host, uint16_t port,
@@ -802,6 +980,13 @@ void hs_net_send(void* ctx, const char* host, uint16_t port,
 void hs_net_close_listener(void* ctx, uint64_t listener_id) {
   Command c;
   c.type = CMD_CLOSE_LISTENER;
+  c.id = listener_id;
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+void hs_net_pause_listener(void* ctx, uint64_t listener_id, int paused) {
+  Command c;
+  c.type = paused ? CMD_PAUSE_LISTENER : CMD_RESUME_LISTENER;
   c.id = listener_id;
   static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
 }
@@ -824,6 +1009,23 @@ void hs_net_reply(void* ctx, uint64_t conn_id, const uint8_t* data,
 
 int64_t hs_net_drain(void* ctx, uint8_t* buf, uint32_t cap) {
   return static_cast<NetCore*>(ctx)->drain(buf, cap);
+}
+
+// out[5] = {pending, inflight, cancelled, out_conns, in_conns}. Blocks
+// until the loop thread services the request (microseconds when live).
+void hs_net_stats(void* ctx, uint64_t* out) {
+  StatsReq req;
+  Command c;
+  c.type = CMD_STATS;
+  c.ptr = &req;
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+  std::unique_lock<std::mutex> lk(req.mu);
+  req.cv.wait(lk, [&] { return req.done; });
+  out[0] = req.pending;
+  out[1] = req.inflight;
+  out[2] = req.cancelled;
+  out[3] = req.out_conns;
+  out[4] = req.in_conns;
 }
 
 }  // extern "C"

@@ -151,6 +151,125 @@ async def test_asyncio_sender_to_native_receiver_interop():
 
 
 @async_test
+async def test_native_cancel_reclaims_dead_peer_backlog():
+    """Cancelling reliable messages to a permanently-down peer reclaims
+    their queued frames immediately (not lazily in pump_out, which never
+    runs while disconnected) — the crash-fault regime must not grow
+    per-round garbage without bound. Observed via the loop-thread stats
+    snapshot."""
+    port = BASE_PORT + 20  # nothing ever listens here
+    transport = hsnative.NativeTransport.get()
+    base = transport.stats()
+    sender = hsnative.NativeReliableSender()
+    futs = [
+        await sender.send(("127.0.0.1", port), b"round-%03d" % i)
+        for i in range(50)
+    ]
+    await asyncio.sleep(0.1)
+    grown = transport.stats()
+    assert grown["pending"] >= base["pending"] + 50
+    for fut in futs:
+        fut.cancel()
+    reclaimed = None
+    for _ in range(150):
+        await asyncio.sleep(0.02)
+        s = transport.stats()
+        if (
+            s["pending"] <= base["pending"]
+            and s["cancelled"] <= base["cancelled"]
+        ):
+            reclaimed = s
+            break
+    assert reclaimed is not None, f"backlog not reclaimed: {transport.stats()}"
+    sender.shutdown()
+
+
+@async_test
+async def test_native_unresolvable_peer_fails_loudly_not_silently():
+    """A hostname the resolver rejects is logged and dropped; a reliable
+    send to it behaves like a permanently-down peer (future pending until
+    cancelled) instead of retrying a bogus address forever."""
+    sender = hsnative.NativeSimpleSender()
+    sender.send(("no-such-host.invalid", 1), b"void")  # must not raise
+    rsender = hsnative.NativeReliableSender()
+    fut = await rsender.send(("no-such-host.invalid", 1), b"void")
+    await asyncio.sleep(0.1)
+    assert not fut.done()
+    fut.cancel()
+    sender.shutdown()
+    rsender.shutdown()
+
+
+@async_test
+async def test_native_hostname_resolution():
+    """Committee files may name peers by hostname: the native transport
+    resolves them (AF_INET) instead of silently dropping every send the
+    way a raw inet_pton-only path would."""
+    port = BASE_PORT + 21
+    task = asyncio.create_task(listener(port, expected=b"named"))
+    await asyncio.sleep(0.05)
+    sender = hsnative.NativeSimpleSender()
+    sender.send(("localhost", port), b"named")
+    assert await asyncio.wait_for(task, 5) == b"named"
+    sender.shutdown()
+
+
+@async_test(timeout=120)
+async def test_native_receiver_flood_is_bounded_and_lossless():
+    """A flooding peer must not grow the Python dispatch queue without
+    bound: past the high-water mark the C++ loop stops reading (TCP
+    back-pressure), and resuming later delivers every frame."""
+    port = BASE_PORT + 22
+    high, low = hsnative.RECV_HIGH_WATER, hsnative.RECV_LOW_WATER
+    hsnative.RECV_HIGH_WATER, hsnative.RECV_LOW_WATER = 64, 16
+    gate = asyncio.Event()
+    seen = []
+
+    class Block(MessageHandler):
+        async def dispatch(self, writer, message):
+            seen.append(message)
+            await gate.wait()
+
+    receiver = None
+    try:
+        receiver = await hsnative.NativeReceiver.spawn(
+            ("127.0.0.1", port), Block()
+        )
+        await asyncio.sleep(0.05)
+        reader, writer = await asyncio.open_connection("127.0.0.1", port)
+        total = 600
+        payload = b"x" * 4096
+
+        async def pump():
+            for i in range(total):
+                write_frame(writer, payload)
+                if i % 20 == 0:
+                    await writer.drain()
+            await writer.drain()
+
+        send_task = asyncio.create_task(pump())
+        max_q = 0
+        for _ in range(100):
+            await asyncio.sleep(0.02)
+            max_q = max(max_q, receiver._queue.qsize())
+        # The pause command races one or two 256 KiB read batches; the
+        # bound is high-water plus that slack, far under the full flood.
+        assert max_q < 300, max_q
+        gate.set()
+        await asyncio.wait_for(send_task, 30)
+        for _ in range(400):
+            await asyncio.sleep(0.05)
+            if len(seen) >= total:
+                break
+        assert len(seen) == total  # paused, resumed, nothing lost
+        writer.close()
+    finally:
+        hsnative.RECV_HIGH_WATER, hsnative.RECV_LOW_WATER = high, low
+        if receiver is not None:
+            await receiver.shutdown()
+
+
+@async_test
 async def test_native_throughput_many_frames():
     """Batched event delivery: thousands of small frames arrive intact
     and in order per connection."""
@@ -162,6 +281,12 @@ async def test_native_throughput_many_frames():
     n = 2000
     for i in range(n):
         sender.send(("127.0.0.1", port), b"m%06d" % i)
+        if i % 400 == 399:
+            # Pace the burst under the best-effort sender's 1000-frame
+            # queue cap (reference simple_sender.rs channel capacity —
+            # both transports drop past it): the real client paces its
+            # bursts too. Unpaced, the test races the drain thread.
+            await asyncio.sleep(0.01)
     for _ in range(100):
         await asyncio.sleep(0.05)
         if len(handler.received) >= n:
