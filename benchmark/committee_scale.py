@@ -162,9 +162,17 @@ def main() -> None:
         )
     else:
         per_round = run_crypto_rounds(args.nodes, args.rounds, args.tc_heavy)
+    # Mirror network/__init__'s selection exactly so the committed result
+    # lines never misattribute a run to a transport that didn't execute.
+    transport = (
+        "native"
+        if os.environ.get("HOTSTUFF_NET", "").lower() == "native"
+        else "asyncio"
+    )
     line = (
         f"committee={args.nodes} (f={f}, QC size {2 * f + 1}) mode={args.mode}"
-        f"{' tc-heavy' if args.tc_heavy else ''} backend={backend}: "
+        f"{' tc-heavy' if args.tc_heavy else ''} backend={backend}"
+        f" transport={transport}: "
         f"{per_round * 1e3:.1f} ms/round ({1 / per_round:.2f} rounds/s)"
     )
     print(line)

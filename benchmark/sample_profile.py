@@ -1,0 +1,90 @@
+"""Sampling profiler for the in-process committee (1-core box).
+
+cProfile's tracing overhead multiplies asyncio's per-event cost so much
+that an N=40 committee cannot even form its mesh inside a CI window; a
+SIGPROF sampler costs one stack walk per interval (~0.3% at 2 ms) and
+leaves the timing honest. Aggregates leaf-ward self time and rolled-up
+cumulative time per function.
+
+    python -m benchmark.sample_profile --nodes 40 --rounds 15
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import collections
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+_samples: collections.Counter[tuple[str, ...]] = collections.Counter()
+_self: collections.Counter[str] = collections.Counter()
+_cum: collections.Counter[str] = collections.Counter()
+_nsamples = 0
+
+
+def _frame_id(frame) -> str:
+    code = frame.f_code
+    fn = code.co_filename
+    # Compress to repo-relative / stdlib-basename names.
+    for marker in ("/hotstuff_tpu/", "/benchmark/"):
+        if marker in fn:
+            fn = marker.strip("/") + "/" + fn.split(marker, 1)[1]
+            break
+    else:
+        fn = os.path.basename(fn)
+    return f"{fn}:{code.co_firstlineno}:{code.co_name}"
+
+
+def _on_prof(signum, frame) -> None:
+    global _nsamples
+    if frame is None:  # delivered with no Python frame current
+        return
+    _nsamples += 1
+    stack = []
+    f = frame
+    while f is not None:
+        stack.append(_frame_id(f))
+        f = f.f_back
+    _self[stack[0]] += 1
+    for name in set(stack):
+        _cum[name] += 1
+
+
+def main() -> None:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=40)
+    p.add_argument("--rounds", type=int, default=15)
+    p.add_argument("--base-port", type=int, default=22000)
+    p.add_argument("--interval-ms", type=float, default=2.0)
+    p.add_argument("--top", type=int, default=35)
+    args = p.parse_args()
+
+    from benchmark.committee_scale import run_committee
+
+    signal.signal(signal.SIGPROF, _on_prof)
+    signal.setitimer(
+        signal.ITIMER_PROF, args.interval_ms / 1e3, args.interval_ms / 1e3
+    )
+    per_round = asyncio.run(
+        run_committee(args.nodes, args.rounds, args.base_port, 30_000)
+    )
+    signal.setitimer(signal.ITIMER_PROF, 0)
+
+    print(
+        f"\ncommittee={args.nodes} protocol: {per_round * 1e3:.1f} ms/round; "
+        f"{_nsamples} samples @ {args.interval_ms} ms (whole run incl. boot)"
+    )
+    print(f"\n{'SELF%':>6} {'CUM%':>6}  function")
+    for name, n in _self.most_common(args.top):
+        print(
+            f"{100 * n / _nsamples:6.2f} {100 * _cum[name] / _nsamples:6.2f}"
+            f"  {name}"
+        )
+
+
+if __name__ == "__main__":
+    main()

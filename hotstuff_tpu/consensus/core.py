@@ -43,6 +43,7 @@ from .messages import (
     QC,
     TC,
     Block,
+    CertificateCache,
     Timeout,
     Vote,
     encode_tc,
@@ -110,6 +111,13 @@ class Core:
         self._verified_seats: dict[Round, set] = {}
         # Strong references to in-flight qc_retry timer tasks.
         self._retry_tasks: set[asyncio.Task] = set()
+        # This node's verified-certificate memory: rebroadcast QCs/TCs
+        # (every view-change timeout carries the same high_qc; every
+        # TC-former broadcasts the TC; timers retransmit) verify once
+        # instead of once per arrival — without it, timeout waves at
+        # committee scale saturate the core in redundant batch verifies
+        # and view changes stretch from one timer period to many.
+        self._cert_cache = CertificateCache()
 
     @classmethod
     def spawn(cls, *args, **kwargs) -> asyncio.Task:
@@ -285,7 +293,9 @@ class Core:
         if qc is None:
             return None
         try:
-            await verify_off_loop(qc.verify, self.committee, n_sigs=len(qc.votes))
+            await verify_off_loop(
+                qc.verify, self.committee, self._cert_cache, n_sigs=len(qc.votes)
+            )
             return qc
         except BackendUnavailable as e:
             # The assembled QC was NOT judged (device/tunnel failure). Its
@@ -330,7 +340,9 @@ class Core:
         if qc.round < self.round:
             return  # the protocol moved on
         try:
-            await verify_off_loop(qc.verify, self.committee, n_sigs=len(qc.votes))
+            await verify_off_loop(
+                qc.verify, self.committee, self._cert_cache, n_sigs=len(qc.votes)
+            )
         except BackendUnavailable:
             self._schedule_qc_retry(qc, attempt + 1)
             return
@@ -407,8 +419,35 @@ class Core:
         log.debug("Processing %r", timeout)
         if timeout.round < self.round:
             return
+        if timeout.round > self.round + self.MAX_ROUND_LOOKAHEAD:
+            # Same state-allocation bound as votes: otherwise one
+            # byzantine member seats a TCMaker (and pays us a full
+            # verification) per arbitrary future round.
+            log.warning(
+                "dropping timeout %d rounds ahead", timeout.round - self.round
+            )
+            return
+        maker = self.aggregator.timeouts_aggregators.get(timeout.round)
+        if maker is not None and timeout.author in maker.used:
+            # Duplicate seat: timers retransmit timeouts every
+            # timeout_delay, so during a long view change each node
+            # receives each peer's timeout many times. Drop BEFORE the
+            # signature verification — the post-verify AuthorityReuse
+            # rejection priced every retransmission at a full high_qc
+            # batch verify, which is exactly the load that stalls
+            # committee-scale view changes. An equivocating second
+            # timeout from the same author was rejected for reuse
+            # anyway — EXCEPT that the old path first adopted its
+            # high_qc; keep that convergence channel by letting a
+            # retransmission carrying a NEWER high_qc through to the
+            # verified path.
+            if timeout.high_qc.round <= self.high_qc.round:
+                return
         await verify_off_loop(
-            timeout.verify, self.committee, n_sigs=1 + len(timeout.high_qc.votes)
+            timeout.verify,
+            self.committee,
+            self._cert_cache,
+            n_sigs=1 + len(timeout.high_qc.votes),
         )
         await self.process_qc(timeout.high_qc)
         tc = self.aggregator.add_timeout(timeout)
@@ -518,7 +557,9 @@ class Core:
                     f"block {digest} from {block.author} at round {block.round}"
                 )
         n_sigs = 1 + len(block.qc.votes) + (len(block.tc.votes) if block.tc else 0)
-        await verify_off_loop(block.verify, self.committee, n_sigs=n_sigs)
+        await verify_off_loop(
+            block.verify, self.committee, self._cert_cache, n_sigs=n_sigs
+        )
         await self.process_qc(block.qc)
         if block.tc is not None:
             await self.advance_round(block.tc.round)
@@ -549,7 +590,16 @@ class Core:
         await self.process_block(block)
 
     async def handle_tc(self, tc: TC) -> None:
-        await verify_off_loop(tc.verify, self.committee, n_sigs=len(tc.votes))
+        # Round check BEFORE the 2f+1-signature verification: every node
+        # that forms the TC broadcasts it, so all but the first arrival
+        # are stale by the time they dequeue — discarding them unverified
+        # removes most of a view change's redundant crypto. (A stale TC
+        # is never used, so skipping its verification changes nothing.)
+        if tc.round < self.round:
+            return
+        await verify_off_loop(
+            tc.verify, self.committee, self._cert_cache, n_sigs=len(tc.votes)
+        )
         if tc.round < self.round:
             return
         await self.advance_round(tc.round)

@@ -34,6 +34,55 @@ from .config import Committee, Round
 _U64 = struct.Struct("<Q")
 
 
+class CertificateCache:
+    """Byte-identical certificates that already verified skip re-verification.
+
+    Why: certificates are *rebroadcast*. During a view change every node's
+    Timeout carries the same high_qc (2f+1 signatures), the assembled TC is
+    broadcast by every node that forms it, and local timers retransmit
+    timeouts every ``timeout_delay``. Without a cache each arrival pays the
+    full batch verification — at N=40 one timeout wave is ~N² ≈ 1,000
+    27-signature batch verifies, which saturates a core and stretches each
+    view change from one timer period to many (observed live as a
+    "timeout grind": rounds advance ~1 per timeout while commit latency
+    collapses). The reference never re-verifies a QC it assembled itself
+    but pays this cost on every received copy too (``messages.rs:180-198``).
+
+    One instance per NODE (held by its Core), never module-level: in the
+    one-process committee testbed a shared cache would let node B skip work
+    node A paid for — unrealistic for the distributed deployment being
+    modeled. Keyed by the certificate's exact serialized bytes, so any
+    tampered variant misses and verifies from scratch. The committee is
+    fixed per Core (epoch changes would need a keyed reset — parity with
+    the reference's static membership).
+    """
+
+    __slots__ = ("cap", "_seen")
+
+    def __init__(self, cap: int = 512) -> None:
+        from collections import OrderedDict
+
+        self.cap = cap
+        self._seen: "OrderedDict[bytes, None]" = OrderedDict()
+
+    @staticmethod
+    def key_of(cert) -> bytes:
+        enc = Encoder()
+        cert.encode(enc)
+        return bytes(enc.finish())
+
+    def hit(self, key: bytes) -> bool:
+        if key in self._seen:
+            self._seen.move_to_end(key)
+            return True
+        return False
+
+    def add(self, key: bytes) -> None:
+        self._seen[key] = None
+        if len(self._seen) > self.cap:
+            self._seen.popitem(last=False)
+
+
 # ---------------------------------------------------------------------------
 # QC
 # ---------------------------------------------------------------------------
@@ -60,9 +109,17 @@ class QC:
             and self.round == other.round
         )
 
-    def verify(self, committee: Committee) -> None:
+    def verify(
+        self, committee: Committee, cache: "CertificateCache | None" = None
+    ) -> None:
         """Stake/duplicate accounting, then batch-verify all vote signatures
-        (reference ``messages.rs:180-198``)."""
+        (reference ``messages.rs:180-198``). With ``cache``, a byte-identical
+        QC that already verified is accepted without re-verification."""
+        key = None
+        if cache is not None:
+            key = CertificateCache.key_of(self)
+            if cache.hit(key):
+                return
         weight = 0
         used = set()
         for name, _ in self.votes:
@@ -81,6 +138,8 @@ class QC:
             raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
+        if cache is not None:
+            cache.add(key)
 
     def encode(self, enc: Encoder) -> None:
         enc.raw(self.hash.data).u64(self.round).seq(
@@ -111,11 +170,19 @@ class TC:
     def high_qc_rounds(self) -> list[Round]:
         return [r for _, _, r in self.votes]
 
-    def verify(self, committee: Committee) -> None:
+    def verify(
+        self, committee: Committee, cache: "CertificateCache | None" = None
+    ) -> None:
         """Stake accounting, then verify per-voter digests — batched through
         the backend's multi-message path (reference ``messages.rs:283-320``
         verifies sig-by-sig; we keep identical acceptance but one device
-        call)."""
+        call). With ``cache``, a byte-identical TC that already verified is
+        accepted without re-verification (every TC-former broadcasts it)."""
+        key = None
+        if cache is not None:
+            key = CertificateCache.key_of(self)
+            if cache.hit(key):
+                return
         weight = 0
         used = set()
         for name, _, _ in self.votes:
@@ -143,6 +210,8 @@ class TC:
             raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
+        if cache is not None:
+            cache.add(key)
 
     def encode(self, enc: Encoder) -> None:
         enc.u64(self.round).seq(
@@ -211,9 +280,13 @@ class Block:
             self.qc.hash.data,
         )
 
-    def verify(self, committee: Committee) -> None:
+    def verify(
+        self, committee: Committee, cache: "CertificateCache | None" = None
+    ) -> None:
         """Author stake + signature + embedded QC/TC (reference
-        ``messages.rs:55-76``)."""
+        ``messages.rs:55-76``). ``cache`` skips re-verifying embedded
+        certificates this node already verified (e.g. the QC also carried
+        by the timeouts that preceded a view-change proposal)."""
         if committee.stake(self.author) == 0:
             raise errors.UnknownAuthority(str(self.author))
         try:
@@ -223,9 +296,9 @@ class Block:
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
         if self.qc != QC.genesis():
-            self.qc.verify(committee)
+            self.qc.verify(committee, cache)
         if self.tc is not None:
-            self.tc.verify(committee)
+            self.tc.verify(committee, cache)
 
     def encode(self, enc: Encoder) -> None:
         self.qc.encode(enc)
@@ -350,7 +423,9 @@ class Timeout:
     def digest(self) -> Digest:
         return sha512_digest(_U64.pack(self.round), _U64.pack(self.high_qc.round))
 
-    def verify(self, committee: Committee) -> None:
+    def verify(
+        self, committee: Committee, cache: "CertificateCache | None" = None
+    ) -> None:
         if committee.stake(self.author) == 0:
             raise errors.UnknownAuthority(str(self.author))
         try:
@@ -360,7 +435,10 @@ class Timeout:
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
         if self.high_qc != QC.genesis():
-            self.high_qc.verify(committee)
+            # The dominant cost: every node's timeout in a view change
+            # carries the same high_qc — the cache collapses N copies to
+            # one batch verification.
+            self.high_qc.verify(committee, cache)
 
     def encode(self, enc: Encoder) -> None:
         self.high_qc.encode(enc)

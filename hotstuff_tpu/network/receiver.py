@@ -84,6 +84,7 @@ class Receiver:
         self.auto_ack = auto_ack
         self._server: asyncio.base_events.Server | None = None
         self._writers: set[asyncio.StreamWriter] = set()
+        self._conn_tasks: set[asyncio.Task] = set()
 
     @classmethod
     async def spawn(
@@ -104,6 +105,7 @@ class Receiver:
         peer = writer.get_extra_info("peername")
         framed = _AckedWriter() if self.auto_ack else FramedWriter(writer)
         self._writers.add(writer)
+        self._conn_tasks.add(asyncio.current_task())
         try:
             while True:
                 frame = await read_frame(reader)
@@ -122,14 +124,34 @@ class Receiver:
         except Exception:
             log.exception("handler error for peer %s", peer)
         finally:
+            self._conn_tasks.discard(asyncio.current_task())
             self._writers.discard(writer)
             writer.close()
 
     async def shutdown(self) -> None:
         if self._server is not None:
             self._server.close()
-            # Close lingering peer connections: Python 3.12's wait_closed()
-            # waits for all client transports, and senders keep theirs open.
+            # Python 3.12's wait_closed() waits for every connection
+            # HANDLER to return. Closing the writers is not enough: a
+            # handler parked in ``handler.dispatch`` (e.g. awaiting a put
+            # on the consensus queue after its consumer was cancelled)
+            # never observes the closed socket and wait_closed() hangs the
+            # whole node teardown (observed live: a 40-node testbed's
+            # shutdown wedging on engine 7 while the survivors ground on).
+            # Cancel the handler tasks outright — shutdown is terminal —
+            # and ABORT the transports: a graceful close() first flushes
+            # the write buffer, which never drains on a flow-controlled
+            # connection, and wait_closed() counts attached transports.
+            for t in list(self._conn_tasks):
+                t.cancel()
             for w in list(self._writers):
-                w.close()
-            await self._server.wait_closed()
+                w.transport.abort()
+            try:
+                await asyncio.wait_for(self._server.wait_closed(), timeout=5)
+            except asyncio.TimeoutError:  # pragma: no cover - defensive
+                log.error(
+                    "receiver %s: wait_closed timed out; abandoning "
+                    "%d lingering connection(s)",
+                    self.address,
+                    len(self._conn_tasks),
+                )

@@ -1,0 +1,254 @@
+"""Crash-fault liveness and teardown regressions.
+
+The reference only exercises crash faults subtractively (the harness
+doesn't boot the last f nodes, ``local.py:75-76``); these tests kill live
+engines mid-run — the regime that exposed three real bugs in round 4:
+
+1. ``Receiver.shutdown`` hung forever in Python 3.12's
+   ``Server.wait_closed()`` when a connection handler was parked in
+   ``dispatch`` (e.g. awaiting a queue whose consumer was cancelled).
+2. Timeout retransmissions were re-verified (full high_qc batch
+   verification) before being dropped as duplicates, so committee-scale
+   view changes saturated the core in redundant crypto and ground for
+   many timer periods per round ("timeout grind").
+3. Every node's timeout carries the same high_qc and every TC-former
+   broadcasts the TC: without a verified-certificate cache each arrival
+   paid the full batch verification again.
+"""
+
+import asyncio
+
+import pytest
+
+from hotstuff_tpu.consensus import Consensus, Parameters
+from hotstuff_tpu.consensus.messages import QC, Block, CertificateCache, Timeout
+from hotstuff_tpu.crypto import Signature, SignatureService
+from hotstuff_tpu.network import MessageHandler
+from hotstuff_tpu.network.receiver import Receiver, write_frame
+from hotstuff_tpu.store import Store
+
+from .common import async_test, consensus_committee, keys
+
+BASE = 14500
+
+
+async def _spawn_committee(n: int, base_port: int, timeout_delay: int):
+    committee = consensus_committee(base_port, n)
+    engines, counts, aux = [], [0] * n, []
+    for j, (pk, sk) in enumerate(keys(n)):
+        rx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_mempool: asyncio.Queue = asyncio.Queue()
+        tx_commit: asyncio.Queue = asyncio.Queue()
+
+        async def drain(q=tx_mempool):
+            while True:
+                await q.get()
+
+        async def count(jj=j, q=tx_commit):
+            while True:
+                await q.get()
+                counts[jj] += 1
+
+        aux.append(asyncio.create_task(drain()))
+        aux.append(asyncio.create_task(count()))
+        engines.append(
+            await Consensus.spawn(
+                pk,
+                committee,
+                Parameters(
+                    timeout_delay=timeout_delay, batch_vote_verification=True
+                ),
+                SignatureService(sk),
+                Store(),
+                rx_mempool,
+                tx_mempool,
+                tx_commit,
+            )
+        )
+    return engines, counts, aux
+
+
+def _crash(engine) -> None:
+    """Kill an engine the unclean way — cancel its tasks and yank its
+    listeners — modeling a process crash, not a graceful shutdown."""
+    for t in engine.tasks:
+        t.cancel()
+    for r in engine.receivers:
+        r._server.close()
+        for w in list(r._writers):
+            w.transport.abort()
+
+
+@async_test(timeout=90)
+async def test_crash_faulted_committee_keeps_committing():
+    """Kill f of N mid-run: the surviving 2f+1 must keep committing.
+    Before the round-4 fixes this ground to a halt (timeout waves cost
+    more crypto than a timer period at scale; dead-leader rounds never
+    cleared)."""
+    n, f = 10, 3
+    engines, counts, aux = await _spawn_committee(n, BASE, timeout_delay=1_000)
+    try:
+        # Let it commit healthy first.
+        for _ in range(200):
+            await asyncio.sleep(0.1)
+            if min(counts) >= 3:
+                break
+        assert min(counts) >= 3, f"healthy committee failed to commit: {counts}"
+
+        for e in engines[:f]:
+            _crash(e)
+
+        live = counts[f:]
+        before = list(live)
+        # Survivors must produce NEW commits: allow several view changes
+        # (3 dead leaders per 10-round rotation at 1 s timeout).
+        deadline = asyncio.get_running_loop().time() + 45
+        while asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(0.5)
+            if all(c >= b + 3 for c, b in zip(counts[f:], before)):
+                break
+        assert all(
+            c >= b + 3 for c, b in zip(counts[f:], before)
+        ), f"survivors stalled after crash-fault: before={before} after={counts[f:]}"
+    finally:
+        for e in engines[f:]:
+            await asyncio.wait_for(e.shutdown(), 10)
+        for t in aux:
+            t.cancel()
+
+
+@async_test
+async def test_receiver_shutdown_completes_with_blocked_handler():
+    """Python 3.12 ``Server.wait_closed()`` waits for every connection
+    handler; a handler parked in dispatch must not wedge shutdown."""
+    port = BASE + 40
+    gate: asyncio.Future = asyncio.get_running_loop().create_future()
+
+    class Block_(MessageHandler):
+        async def dispatch(self, writer, message):
+            await gate  # never resolved — models a dead consumer
+
+    receiver = await Receiver.spawn(("127.0.0.1", port), Block_())
+    reader, writer = await asyncio.open_connection("127.0.0.1", port)
+    write_frame(writer, b"stuck")
+    await writer.drain()
+    await asyncio.sleep(0.2)  # let dispatch park on the gate
+    await asyncio.wait_for(receiver.shutdown(), 10)
+    writer.close()
+
+
+@async_test
+async def test_timeout_duplicate_dropped_before_verification():
+    """Timers retransmit timeouts every timeout_delay; a retransmission
+    whose author already holds a seat must be dropped BEFORE paying the
+    signature verification (the high_qc batch verify per arrival is what
+    saturated committee-scale view changes)."""
+    from hotstuff_tpu.consensus.core import Core
+    from hotstuff_tpu.consensus.leader import RRLeaderElector
+
+    kl = keys(4)
+    committee = consensus_committee(BASE + 60)
+    pk, sk = kl[0]
+    core = Core.__new__(Core)  # state-only instance: no tasks
+    core.name = pk
+    core.committee = committee
+    core.round = 5
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+
+    core.aggregator = Aggregator(committee)
+    core.leader_elector = RRLeaderElector(committee)
+    core._cert_cache = CertificateCache()
+    core.high_qc = QC.genesis()
+
+    timeout = Timeout.new_from_key(QC.genesis(), 5, kl[1][0], kl[1][1])
+    calls = 0
+    orig = Timeout.verify
+
+    def counting_verify(self, committee_, cache=None):
+        nonlocal calls
+        calls += 1
+        return orig(self, committee_, cache)
+
+    Timeout.verify = counting_verify
+    try:
+        await Core.handle_timeout(core, timeout)
+        assert calls == 1
+        await Core.handle_timeout(core, timeout)  # retransmission
+        assert calls == 1, "duplicate timeout was re-verified"
+    finally:
+        Timeout.verify = orig
+
+
+def test_certificate_cache_skips_byte_identical_and_only_those():
+    """A byte-identical QC that verified once skips re-verification; any
+    tampered variant misses the cache and fails from scratch."""
+    kl = keys(4)
+    committee = consensus_committee(BASE + 80)
+    block_digest = Block.genesis().digest()
+    qc = QC(hash=block_digest, round=1, votes=[])
+    qc.votes = [(pk, Signature.new(qc.digest(), sk)) for pk, sk in kl]
+
+    cache = CertificateCache()
+    calls = 0
+    orig = Signature.verify_batch
+
+    def counting_batch(digest, votes):
+        nonlocal calls
+        calls += 1
+        return orig(digest, votes)
+
+    Signature.verify_batch = staticmethod(counting_batch)
+    try:
+        qc.verify(committee, cache)
+        assert calls == 1
+        qc.verify(committee, cache)  # rebroadcast copy: cache hit
+        assert calls == 1
+        qc.verify(committee)  # no cache: verified again
+        assert calls == 2
+
+        # Tampered variant (flip one signature byte): cache miss + reject.
+        bad = QC(hash=qc.hash, round=qc.round, votes=list(qc.votes))
+        pk0, sig0 = bad.votes[0]
+        raw = bytearray(sig0.data)
+        raw[0] ^= 1
+        bad.votes[0] = (pk0, Signature(bytes(raw)))
+        with pytest.raises(Exception):
+            bad.verify(committee, cache)
+        assert calls == 3
+    finally:
+        Signature.verify_batch = staticmethod(orig)
+
+
+@pytest.mark.slow
+@async_test(timeout=240)
+async def test_crash_fault_avalanche_regression_n40():
+    """The committee-scale reproduction of the round-4 'timeout grind':
+    kill 7 of 40 and require sustained commit progress. Pre-fix, timeout
+    waves (~N² high_qc batch verifies per wave, re-verified on every
+    retransmission) saturated the core and commits stopped for minutes."""
+    n, k = 40, 7
+    engines, counts, aux = await _spawn_committee(
+        n, BASE + 120, timeout_delay=5_000
+    )
+    try:
+        for _ in range(400):
+            await asyncio.sleep(0.1)
+            if min(counts) >= 2:
+                break
+        assert min(counts) >= 2, "healthy committee failed to commit"
+        for e in engines[:k]:
+            _crash(e)
+        before = list(counts[k:])
+        deadline = asyncio.get_running_loop().time() + 120
+        while asyncio.get_running_loop().time() < deadline:
+            await asyncio.sleep(1)
+            if all(c >= b + 5 for c, b in zip(counts[k:], before)):
+                break
+        assert all(
+            c >= b + 5 for c, b in zip(counts[k:], before)
+        ), f"avalanche regression: survivors stalled ({before} -> {counts[k:]})"
+    finally:
+        for e in engines[k:]:
+            await asyncio.wait_for(e.shutdown(), 15)
+        for t in aux:
+            t.cancel()
