@@ -162,12 +162,13 @@ def main() -> None:
         )
     else:
         per_round = run_crypto_rounds(args.nodes, args.rounds, args.tc_heavy)
-    # Mirror network/__init__'s selection exactly so the committed result
-    # lines never misattribute a run to a transport that didn't execute.
+    # Ask the network package what it ACTUALLY selected (HOTSTUFF_NET=native
+    # silently falls back to asyncio when the C++ library cannot build) so
+    # committed result lines never claim a transport that didn't run.
+    from hotstuff_tpu import network as _network
+
     transport = (
-        "native"
-        if os.environ.get("HOTSTUFF_NET", "").lower() == "native"
-        else "asyncio"
+        "native" if "Native" in _network.Receiver.__name__ else "asyncio"
     )
     line = (
         f"committee={args.nodes} (f={f}, QC size {2 * f + 1}) mode={args.mode}"

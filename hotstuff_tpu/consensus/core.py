@@ -238,6 +238,18 @@ class Core:
     # attacker can allocate for fabricated future rounds.
     MAX_ROUND_LOOKAHEAD = 1_000
 
+    def _effective_sigs(self, cert, n: int) -> int:
+        """``n`` if the certificate must actually be verified, 0 when a
+        byte-identical copy is already in this node's cache — so the
+        verify-offload policy (``INLINE_SIG_LIMIT``) prices the REAL work:
+        a rebroadcast certificate must not pay an executor hop just to
+        hit the cache inside the worker."""
+        if cert is None:
+            return 0
+        if self._cert_cache.hit(CertificateCache.key_of(cert)):
+            return 0
+        return n
+
     async def handle_vote(self, vote: Vote) -> None:
         log.debug("Processing %r", vote)
         if vote.round < self.round:
@@ -443,11 +455,12 @@ class Core:
             # verified path.
             if timeout.high_qc.round <= self.high_qc.round:
                 return
+        hq = timeout.high_qc
+        n_sigs = 1 + (
+            0 if hq == QC.genesis() else self._effective_sigs(hq, len(hq.votes))
+        )
         await verify_off_loop(
-            timeout.verify,
-            self.committee,
-            self._cert_cache,
-            n_sigs=1 + len(timeout.high_qc.votes),
+            timeout.verify, self.committee, self._cert_cache, n_sigs=n_sigs
         )
         await self.process_qc(timeout.high_qc)
         tc = self.aggregator.add_timeout(timeout)
@@ -556,7 +569,11 @@ class Core:
                 raise WrongLeader(
                     f"block {digest} from {block.author} at round {block.round}"
                 )
-        n_sigs = 1 + len(block.qc.votes) + (len(block.tc.votes) if block.tc else 0)
+        n_sigs = 1
+        if block.qc != QC.genesis():
+            n_sigs += self._effective_sigs(block.qc, len(block.qc.votes))
+        if block.tc is not None:
+            n_sigs += self._effective_sigs(block.tc, len(block.tc.votes))
         await verify_off_loop(
             block.verify, self.committee, self._cert_cache, n_sigs=n_sigs
         )
@@ -598,7 +615,10 @@ class Core:
         if tc.round < self.round:
             return
         await verify_off_loop(
-            tc.verify, self.committee, self._cert_cache, n_sigs=len(tc.votes)
+            tc.verify,
+            self.committee,
+            self._cert_cache,
+            n_sigs=self._effective_sigs(tc, len(tc.votes)),
         )
         if tc.round < self.round:
             return

@@ -235,6 +235,15 @@ static void pt_double(pt& r, const pt& p) {
     fe_mul(r.t, e, h);
 }
 
+static void pt_neg(pt& r, const pt& p) {
+    fe_sub(r.x, FE_ZERO, p.x);
+    fe_carry(r.x);
+    r.y = p.y;
+    r.z = p.z;
+    fe_sub(r.t, FE_ZERO, p.t);
+    fe_carry(r.t);
+}
+
 static bool pt_is_identity(const pt& p) {
     if (!fe_iszero(p.x)) return false;
     // Y == Z != 0: a degenerate (0, 0, 0, *) value — only producible by an
@@ -371,6 +380,114 @@ int hs_ed25519_msm_is_identity(const uint8_t* encodings,
     }
 
     // Cofactored check: 8 * acc == identity.
+    pt_double(acc, acc);
+    pt_double(acc, acc);
+    pt_double(acc, acc);
+    return pt_is_identity(acc) ? 1 : 0;
+}
+
+// Signed-digit Pippenger MSM with optional pre-decompressed points.
+//
+// Two wins over hs_ed25519_msm_is_identity, both aimed at the per-QC
+// batch-verify cost that floors committee-scale rounds:
+//   - pre_xy/flags let the caller reuse committee-key decompressions
+//     (decompression is ~35% of a 67-signature batch on this box; a
+//     validator's committee keys are fixed per epoch — the CPU analog
+//     of the device DevicePointCache);
+//   - signed digits in [-2^(c-1), 2^(c-1)] halve the bucket count, and
+//     the bucket sweep is the second-largest term at QC-sized batches
+//     (negated addition is one fe_sub per use).
+//
+// pre_xy is m*64 bytes of canonical affine x|y (as written by
+// hs_ed25519_decompress_check); flags[i] != 0 selects it over
+// encodings+32*i. Semantics otherwise identical: 1 iff all points valid
+// and 8 * sum(s_i * P_i) == identity.
+int hs_ed25519_msm_signed(const uint8_t* encodings, const uint8_t* pre_xy,
+                          const uint8_t* flags, const uint8_t* scalars,
+                          uint64_t m, int c) {
+    if (encodings == nullptr || scalars == nullptr || m == 0) return -1;
+    if (c < 1) c = 1;
+    if (c > 12) c = 12;
+
+    std::vector<pt> points(m);
+    for (uint64_t i = 0; i < m; i++) {
+        if (flags != nullptr && pre_xy != nullptr && flags[i]) {
+            pt& p = points[i];
+            fe_frombytes(p.x, pre_xy + 64 * i);
+            fe_frombytes(p.y, pre_xy + 64 * i + 32);
+            p.z = FE_ONE;
+            fe_mul(p.t, p.x, p.y);
+        } else if (!pt_decompress(points[i], encodings + 32 * i)) {
+            return 0;
+        }
+    }
+
+    // Signed recode: LSB-first carry pass, digits in [-2^(c-1), 2^(c-1)].
+    const int N_WINDOWS = (253 + c - 1) / c + 1;  // +1 for the top carry
+    const int HALF = 1 << (c - 1);
+    std::vector<int16_t> digits(m * N_WINDOWS);
+    for (uint64_t i = 0; i < m; i++) {
+        int carry = 0;
+        for (int w = 0; w < N_WINDOWS; w++) {
+            int d = (w * c < 256 ? scalar_window(scalars + 32 * i, w * c, c)
+                                 : 0) +
+                    carry;
+            if (d > HALF) {
+                d -= 1 << c;
+                carry = 1;
+            } else {
+                carry = 0;
+            }
+            digits[i * N_WINDOWS + w] = (int16_t)d;
+        }
+    }
+
+    std::vector<pt> buckets(HALF);
+    std::vector<bool> used(HALF);
+    pt acc = PT_IDENTITY;
+    bool acc_started = false;
+    pt negp;
+    for (int w = N_WINDOWS - 1; w >= 0; w--) {
+        if (acc_started) {
+            for (int i = 0; i < c; i++) pt_double(acc, acc);
+        }
+        std::fill(used.begin(), used.end(), false);
+        for (uint64_t i = 0; i < m; i++) {
+            int d = digits[i * N_WINDOWS + w];
+            if (d == 0) continue;
+            const pt* p = &points[i];
+            if (d < 0) {
+                pt_neg(negp, points[i]);
+                p = &negp;
+                d = -d;
+            }
+            if (!used[d - 1]) {
+                buckets[d - 1] = *p;
+                used[d - 1] = true;
+            } else {
+                pt_add(buckets[d - 1], buckets[d - 1], *p);
+            }
+        }
+        pt running = PT_IDENTITY;
+        pt window_sum = PT_IDENTITY;
+        bool any = false;
+        for (int d = HALF - 1; d >= 0; d--) {
+            if (used[d]) {
+                pt_add(running, running, buckets[d]);
+                any = true;
+            }
+            if (any) pt_add(window_sum, window_sum, running);
+        }
+        if (any) {
+            if (acc_started) {
+                pt_add(acc, acc, window_sum);
+            } else {
+                acc = window_sum;
+                acc_started = true;
+            }
+        }
+    }
+
     pt_double(acc, acc);
     pt_double(acc, acc);
     pt_double(acc, acc);

@@ -85,6 +85,7 @@ class Receiver:
         self._server: asyncio.base_events.Server | None = None
         self._writers: set[asyncio.StreamWriter] = set()
         self._conn_tasks: set[asyncio.Task] = set()
+        self._closing = False
 
     @classmethod
     async def spawn(
@@ -102,6 +103,12 @@ class Receiver:
     async def _on_connection(
         self, reader: asyncio.StreamReader, writer: asyncio.StreamWriter
     ) -> None:
+        if self._closing:
+            # Accepted in the race window between shutdown() snapshotting
+            # _conn_tasks and this handler's first iteration: bail so
+            # wait_closed() need not burn its timeout on us.
+            writer.transport.abort()
+            return
         peer = writer.get_extra_info("peername")
         framed = _AckedWriter() if self.auto_ack else FramedWriter(writer)
         self._writers.add(writer)
@@ -130,6 +137,7 @@ class Receiver:
 
     async def shutdown(self) -> None:
         if self._server is not None:
+            self._closing = True
             self._server.close()
             # Python 3.12's wait_closed() waits for every connection
             # HANDLER to return. Closing the writers is not enough: a
