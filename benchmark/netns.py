@@ -1,0 +1,213 @@
+"""Network-namespace testbed: real multi-host benchmarking on one machine.
+
+The reference proves its remote flow on AWS (``benchmark/benchmark/
+remote.py`` + boto3); this environment has neither ssh nor cloud access,
+so the multi-host flow runs against kernel network namespaces instead:
+every "host" gets its own network stack (netns) with an IP on a shared
+bridge, its own home directory with its own git clone of the repo, and
+its own node/client processes. Everything the ssh flow exercises is real
+here — TCP between distinct stacks over veth/bridge, process boot by
+command, log download, crash-fault host skipping — except the transport
+used to reach the host (``ip netns exec`` instead of ssh) and the
+underlying filesystem (shared, so "upload" is a copy).
+
+Topology: bridge ``hsbr0`` at 10.99.0.254/24; host i = netns ``hs<i>``
+with eth0 = 10.99.0.<i>/24. Requires root (this testbed runs as root).
+
+    python -m benchmark.netns --hosts 4 --rate 1000 --duration 20
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shutil
+import subprocess
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+BRIDGE = "hsbr0"
+SUBNET = "10.99.0"
+WORK_ROOT = "/tmp/hs-netns-hosts"
+
+
+def _run(cmd: list[str], check: bool = True, **kw):
+    return subprocess.run(cmd, check=check, capture_output=True, text=True, **kw)
+
+
+def host_ip(i: int) -> str:
+    return f"{SUBNET}.{i + 1}"
+
+
+def ns_name(ip: str) -> str:
+    return "hs" + ip.rsplit(".", 1)[1]
+
+
+def setup(n: int) -> list[str]:
+    """Create the bridge and n namespaces; returns their IPs."""
+    teardown()
+    _run(["ip", "link", "add", BRIDGE, "type", "bridge"])
+    _run(["ip", "addr", "add", f"{SUBNET}.254/24", "dev", BRIDGE])
+    _run(["ip", "link", "set", BRIDGE, "up"])
+    hosts = []
+    for i in range(n):
+        ip = host_ip(i)
+        ns = ns_name(ip)
+        veth = f"hsv{i}"
+        _run(["ip", "netns", "add", ns])
+        _run(
+            ["ip", "link", "add", veth, "type", "veth", "peer", "name",
+             "eth0", "netns", ns]
+        )
+        _run(["ip", "link", "set", veth, "master", BRIDGE])
+        _run(["ip", "link", "set", veth, "up"])
+        _run(["ip", "netns", "exec", ns, "ip", "addr", "add", f"{ip}/24",
+              "dev", "eth0"])
+        _run(["ip", "netns", "exec", ns, "ip", "link", "set", "eth0", "up"])
+        _run(["ip", "netns", "exec", ns, "ip", "link", "set", "lo", "up"])
+        hosts.append(ip)
+    return hosts
+
+
+def teardown() -> None:
+    out = _run(["ip", "netns", "list"], check=False).stdout
+    for line in out.splitlines():
+        name = line.split()[0] if line.split() else ""
+        if name.startswith("hs"):
+            _run(["ip", "netns", "del", name], check=False)
+    _run(["ip", "link", "del", BRIDGE], check=False)
+
+
+class NetnsRunner:
+    """``RemoteBench`` transport backed by ``ip netns exec``.
+
+    Each host's commands run inside its namespace with HOME and CWD set
+    to a private per-host directory, so ``~``-relative paths and process
+    match patterns (``pkill -f``) naturally scope per host even though
+    all hosts share one pid namespace.
+    """
+
+    def __init__(self, repo_path: str | None = None) -> None:
+        self.repo_path = repo_path or os.path.dirname(
+            os.path.dirname(os.path.abspath(__file__))
+        )
+
+    def _home(self, host: str) -> str:
+        return os.path.join(WORK_ROOT, host)
+
+    def exec(self, host: str, command: str, check: bool = True):
+        home = self._home(host)
+        os.makedirs(home, exist_ok=True)
+        env = dict(os.environ, HOME=home)
+        return subprocess.run(
+            ["ip", "netns", "exec", ns_name(host), "bash", "-c", command],
+            check=check,
+            capture_output=True,
+            text=True,
+            cwd=home,
+            env=env,
+        )
+
+    def _map(self, host: str, remote: str) -> str:
+        if remote.startswith("~"):
+            remote = self._home(host) + remote[1:]
+        if not os.path.isabs(remote):
+            remote = os.path.join(self._home(host), remote)
+        return remote
+
+    def put(self, host: str, local: str, remote: str) -> None:
+        dst = self._map(host, remote)
+        os.makedirs(os.path.dirname(dst), exist_ok=True)
+        shutil.copy(local, dst)
+
+    def get(self, host: str, remote: str, local: str) -> None:
+        shutil.copy(self._map(host, remote), local)
+
+    def provision(self, host: str) -> None:
+        """Real clone per host (the install step, sans apt: the base
+        image is the machine we are on)."""
+        home = self._home(host)
+        os.makedirs(home, exist_ok=True)
+        repo_name = os.path.basename(self.repo_path.rstrip("/")) or "repo"
+        dst = os.path.join(home, repo_name)
+        if not os.path.isdir(os.path.join(dst, ".git")):
+            _run(["git", "clone", "--depth", "1",
+                  f"file://{self.repo_path}", dst])
+
+
+def main() -> None:
+    from benchmark.remote import RemoteBench
+    from benchmark.settings import Settings
+
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--hosts", type=int, default=4)
+    p.add_argument("--rate", type=int, default=1_000)
+    p.add_argument("--tx-size", type=int, default=512)
+    p.add_argument("--duration", type=int, default=20)
+    p.add_argument("--faults", type=int, default=0)
+    p.add_argument("--timeout", type=int, default=5_000)
+    p.add_argument("--output", help="directory to append the SUMMARY to")
+    p.add_argument("--keep", action="store_true", help="skip teardown")
+    args = p.parse_args()
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    repo_name = os.path.basename(repo.rstrip("/")) or "repo"
+    settings = Settings(
+        testbed="netns",
+        key_name="-",
+        key_path="-",
+        consensus_port=8000,
+        mempool_port=7000,
+        front_port=6000,
+        repo_name=repo_name,
+        repo_url=f"file://{repo}",
+        branch="main",
+        instance_type="-",
+        aws_regions=[],
+    )
+
+    hosts = setup(args.hosts)
+    try:
+        from hotstuff_tpu.consensus import Parameters as CParams
+        from hotstuff_tpu.mempool import Parameters as MParams
+        from hotstuff_tpu.node.config import Parameters as NodeParams
+
+        bench = RemoteBench(settings, hosts, runner=NetnsRunner(repo))
+        bench.install()
+        bench.config(
+            node_params=NodeParams(
+                CParams(timeout_delay=args.timeout), MParams()
+            )
+        )
+        parser = bench.run(
+            rate=args.rate,
+            tx_size=args.tx_size,
+            duration=args.duration,
+            faults=args.faults,
+            timeout_delay=args.timeout,
+        )
+        summary = parser.result()
+        print(summary)
+        if args.output:
+            os.makedirs(args.output, exist_ok=True)
+            name = (
+                f"remote-netns-{args.faults}-{args.hosts}-"
+                f"{args.rate}-{args.tx_size}.txt"
+            )
+            with open(os.path.join(args.output, name), "a") as f:
+                f.write(summary + "\n")
+    finally:
+        if not args.keep:
+            bench_kill_stragglers()
+            teardown()
+
+
+def bench_kill_stragglers() -> None:
+    _run(["pkill", "-f", WORK_ROOT], check=False)
+    time.sleep(0.5)
+
+
+if __name__ == "__main__":
+    main()

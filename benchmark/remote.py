@@ -36,14 +36,14 @@ class BenchError(Exception):
     pass
 
 
-class RemoteBench:
-    def __init__(self, settings: Settings, hosts: list[str]) -> None:
+class SshRunner:
+    """Host access over plain ``ssh``/``scp`` subprocesses — the
+    real-cluster transport (reference drives Fabric SSH the same way)."""
+
+    def __init__(self, settings: Settings) -> None:
         self.settings = settings
-        self.hosts = hosts
 
-    # -- ssh plumbing -------------------------------------------------------
-
-    def _ssh(self, host: str, command: str, check: bool = True):
+    def exec(self, host: str, command: str, check: bool = True):
         return subprocess.run(
             [
                 "ssh",
@@ -59,7 +59,7 @@ class RemoteBench:
             text=True,
         )
 
-    def _upload(self, host: str, local: str, remote: str) -> None:
+    def put(self, host: str, local: str, remote: str) -> None:
         subprocess.run(
             [
                 "scp",
@@ -74,7 +74,7 @@ class RemoteBench:
             capture_output=True,
         )
 
-    def _download(self, host: str, remote: str, local: str) -> None:
+    def get(self, host: str, remote: str, local: str) -> None:
         subprocess.run(
             [
                 "scp",
@@ -89,11 +89,9 @@ class RemoteBench:
             capture_output=True,
         )
 
-    # -- benchmark flow -----------------------------------------------------
-
-    def install(self) -> None:
-        """Provision hosts: python + a clone of the repo (reference
-        ``remote.py:58-83`` installs rust; we install the python package)."""
+    def provision(self, host: str) -> None:
+        """python + a clone of the repo (reference ``remote.py:58-83``
+        installs rust; we install the python package)."""
         cmd = " && ".join(
             [
                 "sudo apt-get update",
@@ -101,8 +99,38 @@ class RemoteBench:
                 f"(git clone {self.settings.repo_url} || true)",
             ]
         )
+        self.exec(host, cmd)
+
+
+class RemoteBench:
+    def __init__(
+        self, settings: Settings, hosts: list[str], runner=None
+    ) -> None:
+        self.settings = settings
+        self.hosts = hosts
+        # Pluggable host transport: SshRunner for real clusters;
+        # benchmark.netns.NetnsRunner gives each "host" its own kernel
+        # network stack on one machine (real TCP over veth/bridge, real
+        # process boot/kill, real log collection) when no ssh exists.
+        self.runner = runner if runner is not None else SshRunner(settings)
+
+    # -- ssh plumbing (kept as thin aliases; flow code reads better) --------
+
+    def _ssh(self, host: str, command: str, check: bool = True):
+        return self.runner.exec(host, command, check=check)
+
+    def _upload(self, host: str, local: str, remote: str) -> None:
+        self.runner.put(host, local, remote)
+
+    def _download(self, host: str, remote: str, local: str) -> None:
+        self.runner.get(host, remote, local)
+
+    # -- benchmark flow -----------------------------------------------------
+
+    def install(self) -> None:
+        """Provision every host (reference ``remote.py:58-83``)."""
         for host in self.hosts:
-            self._ssh(host, cmd)
+            self.runner.provision(host)
             Print.info(f"installed on {host}")
 
     def update(self) -> None:
