@@ -15,6 +15,7 @@ Digest definitions mirror the reference exactly (SHA-512 truncated to 32 B):
 from __future__ import annotations
 
 import struct
+import threading
 from dataclasses import dataclass
 
 from hotstuff_tpu.crypto import (
@@ -57,13 +58,17 @@ class CertificateCache:
     the reference's static membership).
     """
 
-    __slots__ = ("cap", "_seen")
+    __slots__ = ("cap", "_seen", "_lock")
 
     def __init__(self, cap: int = 512) -> None:
         from collections import OrderedDict
 
         self.cap = cap
         self._seen: "OrderedDict[bytes, None]" = OrderedDict()
+        # hit() runs on the event loop (Core._effective_sigs) while
+        # hit()/add() run in the crypto ThreadPoolExecutor (QC/TC.verify);
+        # OrderedDict check-then-move_to_end is not atomic under that.
+        self._lock = threading.Lock()
 
     @staticmethod
     def key_of(cert) -> bytes:
@@ -72,15 +77,17 @@ class CertificateCache:
         return bytes(enc.finish())
 
     def hit(self, key: bytes) -> bool:
-        if key in self._seen:
-            self._seen.move_to_end(key)
-            return True
-        return False
+        with self._lock:
+            if key in self._seen:
+                self._seen.move_to_end(key)
+                return True
+            return False
 
     def add(self, key: bytes) -> None:
-        self._seen[key] = None
-        if len(self._seen) > self.cap:
-            self._seen.popitem(last=False)
+        with self._lock:
+            self._seen[key] = None
+            if len(self._seen) > self.cap:
+                self._seen.popitem(last=False)
 
 
 # ---------------------------------------------------------------------------

@@ -31,6 +31,7 @@ import random
 import socket
 import struct
 import subprocess
+import time
 
 log = logging.getLogger("network")
 
@@ -50,6 +51,14 @@ RECV_HIGH_WATER = 4_096
 RECV_LOW_WATER = 512
 # Dispatch-progress report granularity (frames per hs_net_consumed call).
 _CONSUMED_BATCH = 32
+
+# How long a failed hostname lookup suppresses further (blocking)
+# getaddrinfo attempts before the next send retries it. Doubles per
+# consecutive failure up to the cap: against a persistently-bad name a
+# flat window would re-run a blocking getaddrinfo (up to ~10 s against a
+# dropping resolver) on the event-loop thread every period, forever.
+_RESOLVE_RETRY_S = 15.0
+_RESOLVE_RETRY_MAX_S = 600.0
 
 _EV_RECV = 1
 _EV_ACKED = 2
@@ -151,6 +160,12 @@ class NativeTransport:
         # whose futures were dropped with the old loop.
         self.generation = 0
         self._resolved: dict[str, str] = {}  # hostname -> IPv4 literal
+        # hostname -> (monotonic deadline to retry a failed lookup,
+        # backoff used for the NEXT failure). Negative results must not
+        # be permanent — a resolver down at boot would cost a correct
+        # peer for the whole process lifetime — but retries back off so
+        # a persistently-bad name doesn't stall the loop every period.
+        self._resolve_retry_at: dict[str, tuple[float, float]] = {}
 
     @classmethod
     def get(cls) -> "NativeTransport":
@@ -195,9 +210,20 @@ class NativeTransport:
         small fixed peer set, so at most one blocking getaddrinfo per
         distinct name per process (same lookup the asyncio transport does
         inside ``open_connection``, which silently diverged before).
-        Unresolvable names fail loudly instead of retrying forever."""
-        if host in self._resolved:  # negative results cached as None
-            return self._resolved[host]
+        Failed lookups are cached only for ``_RESOLVE_RETRY_S`` seconds:
+        a transient resolver outage (e.g. DNS not yet up at boot) must
+        not permanently cost connectivity to a correct peer, but we also
+        must not re-run a BLOCKING getaddrinfo on the loop thread for
+        every single send while the name stays bad."""
+        if host in self._resolved:
+            cached = self._resolved[host]
+            if cached is not None:
+                return cached
+            # Negative entry: honor the retry deadline, then re-resolve.
+            deadline, _ = self._resolve_retry_at.get(host, (0.0, 0.0))
+            if time.monotonic() < deadline:
+                return None
+            del self._resolved[host]
         try:
             ipaddress.IPv4Address(host)
             self._resolved[host] = host
@@ -210,16 +236,22 @@ class NativeTransport:
             )
             addr = infos[0][4][0]
         except OSError as exc:
-            # Cache the failure too: without it every send to the bad
-            # name would re-run a BLOCKING getaddrinfo on the event-loop
-            # thread, stalling consensus for the DNS timeout each round.
+            _, backoff = self._resolve_retry_at.get(
+                host, (0.0, _RESOLVE_RETRY_S)
+            )
             log.warning(
                 "native transport cannot resolve %r (%s): "
-                "dropping all sends to it for this process", host, exc,
+                "dropping sends to it for the next %ds", host, exc,
+                int(backoff),
             )
             self._resolved[host] = None
+            self._resolve_retry_at[host] = (
+                time.monotonic() + backoff,
+                min(backoff * 2, _RESOLVE_RETRY_MAX_S),
+            )
             return None
         self._resolved[host] = addr
+        self._resolve_retry_at.pop(host, None)  # reset failure backoff
         return addr
 
     def listen(

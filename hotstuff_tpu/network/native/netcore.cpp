@@ -260,16 +260,26 @@ class NetCore {
     c.id = id;
     c.flag = auto_ack;
     c.count = (uint64_t(high_water) << 32) | uint64_t(low_water);
-    push_cmd(std::move(c));
+    if (!push_cmd(std::move(c))) {
+      // Loop already shut down: the listener would never be registered.
+      close(fd);
+      return -ESHUTDOWN;
+    }
     return int64_t(id);
   }
 
-  void push_cmd(Command&& c) {
+  // Returns false once the loop thread has stopped accepting commands
+  // (CMD_STOP processed): a command pushed after that would never be
+  // serviced, which matters for synchronous requests (CMD_STATS) whose
+  // caller blocks on completion.
+  bool push_cmd(Command&& c) {
     {
       std::lock_guard<std::mutex> g(cmd_mu_);
+      if (!accepting_) return false;
       commands_.push_back(std::move(c));
     }
     wake();
+    return true;
   }
 
   // Drain events into a packed buffer:
@@ -373,6 +383,29 @@ class NetCore {
         if (c.fd < 0 && c.reliable && c.next_retry_ms <= now) {
           start_connect(c);
         }
+      }
+    }
+    // Stop accepting, then complete any synchronous requests that were
+    // enqueued before the flag flipped — without this a caller blocked
+    // in hs_net_stats would wait forever once the loop thread exits.
+    std::deque<Command> stranded;
+    {
+      std::lock_guard<std::mutex> g(cmd_mu_);
+      accepting_ = false;
+      stranded.swap(commands_);
+    }
+    for (auto& c : stranded) {
+      if (c.type == CMD_STATS) {
+        auto* s = static_cast<StatsReq*>(c.ptr);
+        std::lock_guard<std::mutex> g(s->mu);
+        s->done = true;  // zeros: the loop is gone, nothing is live
+        s->cv.notify_one();
+      } else if (c.type == CMD_ADD_LISTENER && c.fd >= 0) {
+        // listen_on bound it; nobody else will close it. (Its caller
+        // already got a valid id in this narrow window — acceptable:
+        // listen never races destroy in the Python threading model,
+        // and a phantom listener on a closed fd only misses events.)
+        close(c.fd);
       }
     }
   }
@@ -920,6 +953,7 @@ class NetCore {
 
   std::mutex cmd_mu_;
   std::deque<Command> commands_;
+  bool accepting_ = true;  // guarded by cmd_mu_; false once loop() exits
 
   std::mutex ev_mu_;
   std::deque<Event> events_;
@@ -1018,7 +1052,12 @@ void hs_net_stats(void* ctx, uint64_t* out) {
   Command c;
   c.type = CMD_STATS;
   c.ptr = &req;
-  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+  if (!static_cast<NetCore*>(ctx)->push_cmd(std::move(c))) {
+    // Loop thread already exited: report zeros instead of blocking on a
+    // request nothing will ever service.
+    for (int i = 0; i < 5; i++) out[i] = 0;
+    return;
+  }
   std::unique_lock<std::mutex> lk(req.mu);
   req.cv.wait(lk, [&] { return req.done; });
   out[0] = req.pending;

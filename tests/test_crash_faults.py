@@ -17,6 +17,7 @@ engines mid-run — the regime that exposed three real bugs in round 4:
 """
 
 import asyncio
+import time
 
 import pytest
 
@@ -252,3 +253,37 @@ async def test_crash_fault_avalanche_regression_n40():
             await asyncio.wait_for(e.shutdown(), 15)
         for t in aux:
             t.cancel()
+
+
+def test_certificate_cache_concurrent_hit_add():
+    """hit() on the event loop races add()/hit() in the crypto executor
+    (QC/TC.verify offload); with a tiny cap forcing constant eviction,
+    the unlocked OrderedDict raised KeyError from check-then-move_to_end.
+    Regression for advisor finding r4 (messages.py CertificateCache)."""
+    import threading
+
+    cache = CertificateCache(cap=4)
+    keys_ = [bytes([i]) * 8 for i in range(64)]
+    errors: list[BaseException] = []
+    stop = threading.Event()
+
+    def churn(offset: int) -> None:
+        try:
+            i = offset
+            while not stop.is_set():
+                k = keys_[i % len(keys_)]
+                if not cache.hit(k):
+                    cache.add(k)
+                i += 1
+        except BaseException as exc:  # pragma: no cover - failure path
+            errors.append(exc)
+
+    threads = [threading.Thread(target=churn, args=(o,)) for o in range(4)]
+    for t in threads:
+        t.start()
+    time.sleep(1.0)
+    stop.set()
+    for t in threads:
+        t.join()
+    assert not errors, errors
+    assert len(cache._seen) <= cache.cap

@@ -295,3 +295,48 @@ async def test_native_throughput_many_frames():
     assert handler.received == [b"m%06d" % i for i in range(n)]
     sender.shutdown()
     await receiver.shutdown()
+
+
+def test_resolve_negative_cache_has_ttl(monkeypatch):
+    """A transient getaddrinfo failure must not blacklist a peer for the
+    process lifetime (advisor finding r4): after the retry window the
+    next send re-resolves and succeeds."""
+    import socket as socket_mod
+    import time as time_mod
+
+    transport = hsnative.NativeTransport.__new__(hsnative.NativeTransport)
+    transport._resolved = {}
+    transport._resolve_retry_at = {}
+
+    calls = {"n": 0}
+
+    def flaky_getaddrinfo(host, *a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 1:
+            raise OSError("resolver not up yet")
+        return [(socket_mod.AF_INET, socket_mod.SOCK_STREAM, 6, "",
+                 ("10.0.0.7", 0))]
+
+    monkeypatch.setattr(socket_mod, "getaddrinfo", flaky_getaddrinfo)
+
+    assert transport._resolve("node7.example") is None
+    # Within the retry window: cached negative, no new blocking lookup.
+    assert transport._resolve("node7.example") is None
+    assert calls["n"] == 1
+
+    # Consecutive failures back off exponentially (a persistently-bad
+    # name must not stall the loop on a blocking lookup every period).
+    _, next_backoff = transport._resolve_retry_at["node7.example"]
+    assert next_backoff == 2 * hsnative._RESOLVE_RETRY_S
+
+    # Past the window: re-resolves and recovers.
+    monkeypatch.setattr(
+        time_mod, "monotonic",
+        lambda base=time_mod.monotonic(): base + hsnative._RESOLVE_RETRY_S + 1,
+    )
+    assert transport._resolve("node7.example") == "10.0.0.7"
+    assert calls["n"] == 2
+    # Positive result cached; failure backoff state reset.
+    assert transport._resolve("node7.example") == "10.0.0.7"
+    assert calls["n"] == 2
+    assert "node7.example" not in transport._resolve_retry_at
