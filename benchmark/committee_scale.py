@@ -36,7 +36,13 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
-async def run_committee(n: int, rounds_target: int, base_port: int, timeout_delay: int):
+async def run_committee(
+    n: int,
+    rounds_target: int,
+    base_port: int,
+    timeout_delay: int,
+    profile: dict | None = None,
+):
     from hotstuff_tpu.consensus import Authority, Committee, Consensus, Parameters
     from hotstuff_tpu.crypto import SignatureService, generate_keypair
     from hotstuff_tpu.store import Store
@@ -73,16 +79,25 @@ async def run_committee(n: int, rounds_target: int, base_port: int, timeout_dela
                 rx_mempool,
                 tx_mempool,
                 tx_commit,
+                profile=profile,
             )
         )
         commits.append(tx_commit)
 
     # Wait for the first commit everywhere, then time rounds_target more.
     await asyncio.gather(*[q.get() for q in commits])
+    warmup = (
+        {k: list(v) for k, v in profile.items()} if profile is not None else None
+    )
     t0 = time.perf_counter()
     for _ in range(rounds_target):
         await asyncio.gather(*[q.get() for q in commits])
     elapsed = time.perf_counter() - t0
+    if profile is not None:
+        # Reduce to the measured window only (warm-up handlers excluded).
+        for kind, (ns, calls) in list(profile.items()):
+            base_ns, base_calls = warmup.get(kind, (0, 0))
+            profile[kind] = [ns - base_ns, calls - base_calls]
 
     for e in engines:
         await e.shutdown()
@@ -149,16 +164,36 @@ def main() -> None:
     p.add_argument("--timeout", type=int, default=30_000)
     p.add_argument("--mode", choices=["protocol", "crypto"], default="protocol")
     p.add_argument("--tc-heavy", action="store_true")
+    p.add_argument(
+        "--profile",
+        action="store_true",
+        help="protocol mode: print per-stage µs/round (aggregated over "
+        "every engine's core — the whole committee's per-round handler "
+        "cost on this core)",
+    )
     p.add_argument("--output", help="directory to append the result file to")
     args = p.parse_args()
+
+    if args.mode == "protocol":
+        # The one-process committee multiplexes N engines' verification
+        # requests through one crypto plane: enough bridge workers must
+        # exist for concurrent requests to POOL in the superbatching
+        # backend (fusion+dedup collapses the N byte-identical QC
+        # verifies of a round to one MSM). With the default 2 workers the
+        # pool depth is 2 and fusion never happens. Explicit env wins.
+        os.environ.setdefault("HOTSTUFF_CRYPTO_WORKERS", "32")
 
     from hotstuff_tpu.crypto import get_backend
 
     backend = get_backend().name
     f = (args.nodes - 1) // 3
+    profile: dict | None = {} if (args.profile and args.mode == "protocol") else None
     if args.mode == "protocol":
         per_round = asyncio.run(
-            run_committee(args.nodes, args.rounds, args.base_port, args.timeout)
+            run_committee(
+                args.nodes, args.rounds, args.base_port, args.timeout,
+                profile=profile,
+            )
         )
     else:
         per_round = run_crypto_rounds(args.nodes, args.rounds, args.tc_heavy)
@@ -177,6 +212,19 @@ def main() -> None:
         f"{per_round * 1e3:.1f} ms/round ({1 / per_round:.2f} rounds/s)"
     )
     print(line)
+    if profile:
+        # Aggregated over ALL engines: the committee's whole per-round
+        # handler bill on this core, by stage.
+        print(f"per-stage handler cost (all {args.nodes} engines, "
+              f"{args.rounds} measured rounds):")
+        print(f"  {'stage':<10} {'calls/round':>12} {'us/round':>12}")
+        for kind, (ns, calls) in sorted(
+            profile.items(), key=lambda kv: -kv[1][0]
+        ):
+            print(
+                f"  {kind:<10} {calls / args.rounds:>12.1f} "
+                f"{ns / 1e3 / args.rounds:>12.1f}"
+            )
     if args.output:
         os.makedirs(args.output, exist_ok=True)
         tag = f"{args.mode}{'-tc' if args.tc_heavy else ''}"

@@ -21,7 +21,7 @@ from .errors import MalformedMessage
 from .helper import Helper
 from .leader import make_elector
 from .mempool_driver import MempoolDriver
-from .messages import decode_message
+from .messages import decode_message, decode_vote_frame
 from .proposer import Proposer
 from .synchronizer import Synchronizer
 
@@ -51,6 +51,20 @@ class ConsensusReceiverHandler(MessageHandler):
         else:
             await self.tx_consensus.put((kind, payload))
 
+    async def dispatch_votes(self, frames: list[bytes]) -> None:
+        """Aggregated ingress from the native vote pre-stage: one queue
+        put for the whole batch (the core re-checks round/authority and
+        performs the full signature verification — the pre-stage is a
+        filter, never a trust root)."""
+        votes = []
+        for frame in frames:
+            try:
+                votes.append(decode_vote_frame(frame))
+            except (SerdeError, MalformedMessage, ValueError) as e:
+                log.warning("failed to decode pre-staged vote: %s", e)
+        if votes:
+            await self.tx_consensus.put(("votes", votes))
+
 
 class Consensus:
     def __init__(self) -> None:
@@ -71,6 +85,7 @@ class Consensus:
         tx_mempool: asyncio.Queue,  # Synchronize/Cleanup to mempool
         tx_commit: asyncio.Queue,  # committed blocks out
         benchmark: bool = False,
+        profile: dict | None = None,  # per-stage ns accumulator (bench)
     ) -> "Consensus":
         self = cls()
         parameters.log()
@@ -90,14 +105,24 @@ class Consensus:
         # process to be scheduled. Non-proposal messages arrive via
         # SimpleSender, which discards replies, so the extra ACK frames
         # are harmless.
-        self.receivers.append(
-            await Receiver.spawn(
-                ("0.0.0.0", address[1]),
-                ConsensusReceiverHandler(tx_consensus, tx_helper),
-                auto_ack=True,
-            )
+        receiver = await Receiver.spawn(
+            ("0.0.0.0", address[1]),
+            ConsensusReceiverHandler(tx_consensus, tx_helper),
+            auto_ack=True,
         )
+        self.receivers.append(receiver)
         log.info("Node %s listening to consensus messages on %s", name, address)
+
+        # Native transport: push the committee table down so the vote
+        # fan-in stays in C++ (length-validate, seat-check, round-gate,
+        # dedupe, batch) and keep the engine's stale-round cutoff synced
+        # with the core's round. The asyncio receiver has neither hook —
+        # votes then flow per-frame through dispatch() exactly as before.
+        on_round_advance = None
+        configure_prestage = getattr(receiver, "configure_vote_prestage", None)
+        if configure_prestage is not None:
+            configure_prestage([pk.data for pk in committee.authorities])
+            on_round_advance = receiver.set_round
 
         leader_elector = make_elector(committee, parameters.leader_elector)
         self.mempool_driver = MempoolDriver(store, tx_mempool, tx_loopback)
@@ -122,6 +147,8 @@ class Consensus:
                 benchmark=benchmark,
                 persist_sync=parameters.persist_sync,
                 batch_vote_verification=parameters.batch_vote_verification,
+                on_round_advance=on_round_advance,
+                profile=profile,
             )
         )
         self.tasks.append(

@@ -78,6 +78,8 @@ class Core:
         benchmark: bool = False,
         persist_sync: bool = False,
         batch_vote_verification: bool = False,
+        on_round_advance=None,
+        profile: dict | None = None,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -111,6 +113,14 @@ class Core:
         self._verified_seats: dict[Round, set] = {}
         # Strong references to in-flight qc_retry timer tasks.
         self._retry_tasks: set[asyncio.Task] = set()
+        # Native-transport hook: pushes each round advance down to the
+        # C++ vote pre-stage so its stale-round cutoff tracks the core's.
+        # None on the asyncio transport.
+        self._on_round_advance = on_round_advance
+        # Optional per-stage profiling (benchmark --profile): kind ->
+        # [total_ns, calls]. One perf_counter_ns pair per event when on;
+        # zero branches beyond a None check when off.
+        self._profile = profile
         # This node's verified-certificate memory: rebroadcast QCs/TCs
         # (every view-change timeout carries the same high_qc; every
         # TC-former broadcasts the TC; timers retransmit) verify once
@@ -157,6 +167,9 @@ class Core:
 
     async def store_block(self, block: Block) -> None:
         await self.store.write(block.digest().data, block.serialize())
+        # This block is next round's parent: seed the synchronizer's
+        # ancestor cache so the commit path doesn't re-deserialize it.
+        self.synchronizer.cache_block(block)
 
     def increase_last_voted_round(self, target: Round) -> None:
         self.last_voted_round = max(self.last_voted_round, target)
@@ -249,6 +262,15 @@ class Core:
         if self._cert_cache.hit(CertificateCache.key_of(cert)):
             return 0
         return n
+
+    async def handle_vote_batch(self, votes: list[Vote]) -> None:
+        """Aggregated fan-in from the native pre-stage: one dequeue for a
+        whole poll cycle's admitted votes. Each vote runs the exact
+        per-vote pipeline (cheap checks, aggregation, verification,
+        byzantine ejection) under its own error guard, so one byzantine
+        vote never poisons the rest of its batch."""
+        for vote in votes:
+            await self._guarded(self.handle_vote(vote))
 
     async def handle_vote(self, vote: Vote) -> None:
         log.debug("Processing %r", vote)
@@ -477,6 +499,8 @@ class Core:
             return
         self.timer.reset()
         self.round = round_ + 1
+        if self._on_round_advance is not None:
+            self._on_round_advance(self.round)
         log.debug("Moved to round %d", self.round)
         self.aggregator.cleanup(self.round)
         self._bad_sigs = {r: s for r, s in self._bad_sigs.items() if r >= self.round}
@@ -659,6 +683,7 @@ class Core:
         handlers = {
             "propose": self.handle_proposal,
             "vote": self.handle_vote,
+            "votes": self.handle_vote_batch,  # native pre-stage batches
             "timeout": self.handle_timeout,
             "tc": self.handle_tc,
             "qc_retry": self._handle_qc_retry,  # internal loopback
@@ -666,6 +691,12 @@ class Core:
         }
         self._timer_handled = asyncio.Event()
         timer_task = asyncio.create_task(self._timer_pump(), name="consensus_timer")
+        if self._on_round_advance is not None:
+            # Seed the pre-stage cutoff with the (possibly restored) round.
+            self._on_round_advance(self.round)
+        profile = self._profile
+        if profile is not None:
+            import time as _time
         try:
             while True:
                 kind, payload = await self.rx_message.get()
@@ -680,8 +711,14 @@ class Core:
                 handler = handlers.get(kind)
                 if handler is None:
                     log.error("unexpected protocol message kind %s", kind)
-                else:
+                elif profile is None:
                     await self._guarded(handler(payload))
+                else:
+                    t0 = _time.perf_counter_ns()
+                    await self._guarded(handler(payload))
+                    slot = profile.setdefault(kind, [0, 0])
+                    slot[0] += _time.perf_counter_ns() - t0
+                    slot[1] += 1
         finally:
             timer_task.cancel()
 

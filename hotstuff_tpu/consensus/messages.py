@@ -34,6 +34,23 @@ from .config import Committee, Round
 
 _U64 = struct.Struct("<Q")
 
+# Decoded public keys interned by raw bytes: the same ~N committee keys
+# appear in EVERY QC/TC/vote this process ever decodes (67 per QC at
+# N=100), and constructing a fresh PublicKey per appearance — validation,
+# copy, re-hash on every dict lookup — was a top CPU line of the N=100
+# protocol bench. Interning also makes dict/set lookups hit CPython's
+# identity fast path and reuses the cached bytes hash.
+_PK_INTERN: dict[bytes, "PublicKey"] = {}
+
+
+def _intern_pk(raw: bytes) -> PublicKey:
+    pk = _PK_INTERN.get(raw)
+    if pk is None:
+        if len(_PK_INTERN) >= 4096:  # byzantine spray bound; committees are small
+            _PK_INTERN.clear()
+        pk = _PK_INTERN[raw] = PublicKey(raw)
+    return pk
+
 
 class CertificateCache:
     """Byte-identical certificates that already verified skip re-verification.
@@ -72,9 +89,19 @@ class CertificateCache:
 
     @staticmethod
     def key_of(cert) -> bytes:
-        enc = Encoder()
-        cert.encode(enc)
-        return bytes(enc.finish())
+        # Memoized on the certificate object: the core keys the cache
+        # check in _effective_sigs and the verify path re-keys inside
+        # QC/TC.verify — one encode instead of two per certificate, and
+        # zero for repeats. Certificates are never mutated after
+        # construction (ejection builds new QC objects), so the memo
+        # cannot go stale.
+        key = cert.__dict__.get("_cache_key")
+        if key is None:
+            enc = Encoder()
+            cert.encode(enc)
+            key = bytes(enc.finish())
+            cert._cache_key = key
+        return key
 
     def hit(self, key: bytes) -> bool:
         with self._lock:
@@ -157,7 +184,7 @@ class QC:
     def decode(cls, dec: Decoder) -> "QC":
         h = Digest(dec.raw(32))
         rnd = dec.u64()
-        votes = dec.seq(lambda d: (PublicKey(d.raw(32)), Signature(d.raw(64))))
+        votes = dec.seq(lambda d: (_intern_pk(d.raw(32)), Signature(d.raw(64))))
         return cls(h, rnd, votes)
 
     def __repr__(self) -> str:
@@ -229,7 +256,7 @@ class TC:
     def decode(cls, dec: Decoder) -> "TC":
         rnd = dec.u64()
         votes = dec.seq(
-            lambda d: (PublicKey(d.raw(32)), Signature(d.raw(64)), d.u64())
+            lambda d: (_intern_pk(d.raw(32)), Signature(d.raw(64)), d.u64())
         )
         return cls(rnd, votes)
 
@@ -318,7 +345,7 @@ class Block:
     def decode(cls, dec: Decoder) -> "Block":
         qc = QC.decode(dec)
         tc = dec.option(TC.decode)
-        author = PublicKey(dec.raw(32))
+        author = _intern_pk(dec.raw(32))
         rnd = dec.u64()
         payload = dec.seq(lambda d: Digest(d.raw(32)))
         sig = Signature(dec.raw(64))
@@ -326,16 +353,27 @@ class Block:
 
     def serialize(self) -> bytes:
         """Standalone encoding — the form blocks are stored under in the
-        store (reference ``core.rs:89-93``)."""
-        enc = Encoder()
-        self.encode(enc)
-        return enc.finish()
+        store (reference ``core.rs:89-93``).
+
+        Memoized: a received block already carries its exact wire bytes
+        (attached by the decoder — the encoding is canonical, so bytes
+        in == bytes out), and a locally-built block is encoded once for
+        its broadcast and reused for the store write. Blocks are treated
+        as immutable after construction."""
+        wire = self.__dict__.get("_wire")
+        if wire is None:
+            enc = Encoder()
+            self.encode(enc)
+            wire = enc.finish()
+            self._wire = wire
+        return wire
 
     @classmethod
     def deserialize(cls, data: bytes) -> "Block":
         dec = Decoder(data)
         block = cls.decode(dec)
         dec.finish()
+        block._wire = bytes(data)
         return block
 
     def __str__(self) -> str:
@@ -473,9 +511,9 @@ TAG_SYNC_REQUEST = 4
 
 
 def encode_propose(block: Block) -> bytes:
-    enc = Encoder().u8(TAG_PROPOSE)
-    block.encode(enc)
-    return enc.finish()
+    # Rides the block's memoized wire bytes (one encode per block per
+    # process, shared between broadcast and store).
+    return bytes([TAG_PROPOSE]) + block.serialize()
 
 
 def encode_vote(vote: Vote) -> bytes:
@@ -500,14 +538,46 @@ def encode_sync_request(missing: Digest, origin: PublicKey) -> bytes:
     return Encoder().u8(TAG_SYNC_REQUEST).raw(missing.data).raw(origin.data).finish()
 
 
+# Fixed Vote wire layout (TAG_VOTE + Vote.encode):
+#   u8 tag | 32B hash | u64 LE round | 32B author | 64B signature
+# The native transport's vote pre-stage length-validates and decodes
+# round/author from these offsets in C++ (network/native/netcore.cpp);
+# this is the matching batch decoder for the frames it admits.
+VOTE_WIRE_LEN = 137
+_VOTE_ROUND = struct.Struct("<Q")
+
+
+def decode_vote_frame(data: bytes) -> Vote:
+    """Decode one fixed-layout vote frame (fast path: direct slicing, no
+    Decoder object). Accepts exactly what ``decode_message`` would return
+    ``("vote", ...)`` for."""
+    if len(data) != VOTE_WIRE_LEN or data[0] != TAG_VOTE:
+        raise errors.MalformedMessage("not a fixed-layout vote frame")
+    return Vote(
+        Digest(data[1:33]),
+        _VOTE_ROUND.unpack_from(data, 33)[0],
+        _intern_pk(data[41:73]),
+        Signature(data[73:137]),
+    )
+
+
 def decode_message(data: bytes):
     """Returns (kind, payload). Raises on malformed/byzantine input."""
     dec = Decoder(data)
     tag = dec.u8()
     if tag == TAG_PROPOSE:
-        out = ("propose", Block.decode(dec))
+        block = Block.decode(dec)
+        dec.finish()
+        # The canonical encoding means the frame's tail IS the block's
+        # serialization: attach it so store_block never re-encodes the
+        # 2f+1-vote QC it just decoded.
+        block._wire = bytes(data[1:])
+        return ("propose", block)
     elif tag == TAG_VOTE:
-        out = ("vote", Vote.decode(dec))
+        out = ("vote", Vote(
+            Digest(dec.raw(32)), dec.u64(), _intern_pk(dec.raw(32)),
+            Signature(dec.raw(64)),
+        ))
     elif tag == TAG_TIMEOUT:
         out = ("timeout", Timeout.decode(dec))
     elif tag == TAG_TC:

@@ -23,11 +23,17 @@ import os
 import secrets
 import time
 
-from cryptography.exceptions import InvalidSignature
-from cryptography.hazmat.primitives.asymmetric.ed25519 import (
-    Ed25519PrivateKey,
-    Ed25519PublicKey,
-)
+try:
+    from cryptography.exceptions import InvalidSignature
+    from cryptography.hazmat.primitives.asymmetric.ed25519 import (
+        Ed25519PrivateKey,
+        Ed25519PublicKey,
+    )
+
+    _HAVE_PYCA = True
+except ImportError:  # gated: some deploy images ship no OpenSSL binding
+    InvalidSignature = Ed25519PrivateKey = Ed25519PublicKey = None
+    _HAVE_PYCA = False
 
 from . import ed25519_ref
 
@@ -53,7 +59,9 @@ class Digest:
     def __init__(self, data: bytes) -> None:
         if len(data) != self.SIZE:
             raise ValueError(f"digest must be {self.SIZE} bytes, got {len(data)}")
-        self.data = bytes(data)
+        # type-check without copying: wire decode hands us immutable
+        # bytes already, and these run per decoded signature/key
+        self.data = data if type(data) is bytes else bytes(data)
 
     @classmethod
     def default(cls) -> "Digest":
@@ -90,6 +98,135 @@ def sha512_digest(*chunks: bytes) -> Digest:
     return Digest(h.digest()[:32])
 
 
+# ---------------------------------------------------------------------------
+# Gated Ed25519 signing/derivation: used when the ``cryptography`` package
+# (the OpenSSL binding) is not installed. SHA-512 and mod-L scalar
+# arithmetic run here; the fixed-base scalar multiplications go to the
+# native C++ engine (``crypto/native/ed25519.cpp``), with the pure-Python
+# RFC 8032 oracle as the last-resort fallback. RFC 8032 output is
+# byte-identical to OpenSSL's, so signatures from gated and non-gated
+# processes interoperate.
+# ---------------------------------------------------------------------------
+
+_NATIVE_SCALARMULT = None  # resolved lazily: callable, or False if absent
+
+
+def _scalarmult_base(scalar: int) -> bytes:
+    global _NATIVE_SCALARMULT
+    if _NATIVE_SCALARMULT is None:
+        try:
+            from .native_ed25519 import native_available, scalarmult_base_native
+
+            _NATIVE_SCALARMULT = (
+                scalarmult_base_native if native_available() else False
+            )
+        except Exception:  # toolchain unavailable: pure-Python fallback
+            _NATIVE_SCALARMULT = False
+    if _NATIVE_SCALARMULT:
+        return _NATIVE_SCALARMULT(scalar)
+    return ed25519_ref.point_compress(ed25519_ref.point_mul(scalar, ed25519_ref.G))
+
+
+class _GatedSigner:
+    """Expanded Ed25519 key for one seed (cached: key expansion is one
+    SHA-512 plus a scalar multiplication)."""
+
+    __slots__ = ("a", "prefix", "pub")
+
+    def __init__(self, seed: bytes) -> None:
+        self.a, self.prefix = ed25519_ref.secret_expand(seed)
+        self.pub = _scalarmult_base(self.a)
+
+    def sign(self, msg: bytes) -> bytes:
+        r = (
+            int.from_bytes(
+                hashlib.sha512(self.prefix + msg).digest(), "little"
+            )
+            % ed25519_ref.L
+        )
+        big_r = _scalarmult_base(r)
+        k = (
+            int.from_bytes(
+                hashlib.sha512(big_r + self.pub + msg).digest(), "little"
+            )
+            % ed25519_ref.L
+        )
+        s = (r + k * self.a) % ed25519_ref.L
+        return big_r + s.to_bytes(32, "little")
+
+
+_SIGNER_CACHE: dict[bytes, _GatedSigner] = {}
+
+
+def _gated_signer(seed: bytes) -> _GatedSigner:
+    signer = _SIGNER_CACHE.get(seed)
+    if signer is None:
+        if len(_SIGNER_CACHE) >= 4096:  # committees are far smaller
+            _SIGNER_CACHE.clear()
+        signer = _SIGNER_CACHE[seed] = _GatedSigner(seed)
+    return signer
+
+
+class _StrictSingleBackend:
+    """Inner backend for the strict-single fuser: verifies each DISTINCT
+    (msg, pub, sig) triple with the native cofactorless 3-point MSM.
+    Strictness is per-triple (no RLC across items — a random linear
+    combination without cofactor clearing could cancel torsion components
+    with probability 1/8 per bad item, which is not a sound strict
+    verdict), so the fuser's win is purely the identical-triple dedup:
+    a proposal fanned to N in-process validators costs ONE strict MSM
+    instead of N."""
+
+    name = "cpu-strict-single"
+
+    def verify_batch(self, msgs, pubs, sigs) -> None:
+        from .native_ed25519 import verify_single_strict_native
+
+        for msg, pub, sig in zip(msgs, pubs, sigs):
+            if not verify_single_strict_native(msg, pub, sig):
+                raise CryptoError("invalid signature")
+
+
+_STRICT_FUSER = None  # BatchingBackend over _StrictSingleBackend, lazy
+
+
+def _verify_single_gated(msg: bytes, pub: bytes, sig: bytes) -> bool:
+    """Single-signature verification without OpenSSL: the COFACTORLESS
+    equation on the native engine (one 3-point MSM), falling back to the
+    pure-Python strict oracle — so gated and OpenSSL-backed processes
+    share exactly one strict acceptance set (the
+    ``test_cofactored_batch_semantics_unified`` contract). The
+    small-order/canonicality rejections run in the caller.
+
+    Concurrent strict singles route through a fusing wrapper so
+    byte-identical requests (a proposal's author signature verified by
+    every in-process validator at once) dedup to one MSM; verdicts stay
+    exact per request (the wrapper re-verifies individually if a fused
+    flush rejects)."""
+    global _STRICT_FUSER
+    if _STRICT_FUSER is None:
+        try:
+            from .native_ed25519 import native_available
+
+            if native_available():
+                from .batching import BatchingBackend
+
+                _STRICT_FUSER = BatchingBackend(_StrictSingleBackend())
+            else:
+                _STRICT_FUSER = False
+        except Exception:
+            _STRICT_FUSER = False
+    if _STRICT_FUSER is False:
+        return ed25519_ref.verify(pub, msg, sig, strict=True)
+    try:
+        _STRICT_FUSER.verify_batch([msg], [pub], [sig])
+        return True
+    except BackendUnavailable:
+        raise
+    except CryptoError:
+        return False
+
+
 class PublicKey:
     """Compressed Edwards point, 32 bytes; base64 serde; ordered (for
     round-robin leader election over sorted keys, reference
@@ -101,7 +238,9 @@ class PublicKey:
     def __init__(self, data: bytes) -> None:
         if len(data) != self.SIZE:
             raise ValueError("public key must be 32 bytes")
-        self.data = bytes(data)
+        # type-check without copying: wire decode hands us immutable
+        # bytes already, and these run per decoded signature/key
+        self.data = data if type(data) is bytes else bytes(data)
 
     @classmethod
     def decode_base64(cls, s: str) -> "PublicKey":
@@ -150,8 +289,10 @@ class SecretKey:
         return base64.standard_b64encode(self.seed).decode()
 
     def public_key(self) -> PublicKey:
-        sk = Ed25519PrivateKey.from_private_bytes(self.seed)
-        return PublicKey(sk.public_key().public_bytes_raw())
+        if _HAVE_PYCA:
+            sk = Ed25519PrivateKey.from_private_bytes(self.seed)
+            return PublicKey(sk.public_key().public_bytes_raw())
+        return PublicKey(_gated_signer(self.seed).pub)
 
 
 def generate_keypair(rng: "secrets.SystemRandom | None" = None, *, seed: bytes | None = None):
@@ -180,7 +321,9 @@ class Signature:
     def __init__(self, data: bytes) -> None:
         if len(data) != self.SIZE:
             raise ValueError("signature must be 64 bytes")
-        self.data = bytes(data)
+        # type-check without copying: wire decode hands us immutable
+        # bytes already, and these run per decoded signature/key
+        self.data = data if type(data) is bytes else bytes(data)
 
     @classmethod
     def default(cls) -> "Signature":
@@ -189,8 +332,10 @@ class Signature:
     @classmethod
     def new(cls, digest: Digest, secret: SecretKey) -> "Signature":
         """Sign a 32-byte digest (reference ``Signature::new``, ``:185``)."""
-        sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
-        return cls(sk.sign(digest.data))
+        if _HAVE_PYCA:
+            sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
+            return cls(sk.sign(digest.data))
+        return cls(_gated_signer(secret.seed).sign(digest.data))
 
     def __bytes__(self) -> bytes:
         return self.data
@@ -213,14 +358,19 @@ class Signature:
         # OpenSSL's verify is cofactorless (sB == R + hA) and rejects
         # non-canonical s, matching verify_strict's equation; additionally
         # reject small-order R/A like dalek does.
-        try:
-            Ed25519PublicKey.from_public_bytes(public_key.data).verify(
-                self.data, digest.data
-            )
-        except (InvalidSignature, ValueError) as e:
-            raise CryptoError(f"invalid signature: {e}") from e
         if not _strict_point_checks(public_key.data, self.data):
             raise CryptoError("small-order or non-canonical point in signature")
+        if _HAVE_PYCA:
+            try:
+                Ed25519PublicKey.from_public_bytes(public_key.data).verify(
+                    self.data, digest.data
+                )
+            except (InvalidSignature, ValueError) as e:
+                raise CryptoError(f"invalid signature: {e}") from e
+        elif not _verify_single_gated(
+            digest.data, public_key.data, self.data
+        ):
+            raise CryptoError("invalid signature")
 
     @staticmethod
     def verify_batch(digest: Digest, votes) -> None:
@@ -350,11 +500,18 @@ class CpuBackend:
     def verify_batch(self, msgs, pubs, sigs) -> None:
         if not len(msgs) == len(pubs) == len(sigs):
             raise CryptoError("batch length mismatch")
-        if self._rlc is not None and len(msgs) >= 2:
+        # Without OpenSSL, even a batch of one routes to the native RLC
+        # engine — the pure-Python serial loop below is milliseconds per
+        # signature and only ever acceptable as the last-resort fallback.
+        if self._rlc is not None and (len(msgs) >= 2 or not _HAVE_PYCA):
             if not self._rlc(msgs, pubs, sigs):
                 raise CryptoError("invalid signature in batch")
             return
         for msg, pub, sig in zip(msgs, pubs, sigs):
+            if not _HAVE_PYCA:
+                if not ed25519_ref.verify(pub, msg, sig, strict=False):
+                    raise CryptoError("invalid signature in batch")
+                continue
             try:
                 Ed25519PublicKey.from_public_bytes(pub).verify(sig, msg)
             except (InvalidSignature, ValueError):
@@ -420,10 +577,17 @@ class SignatureService:
     """
 
     def __init__(self, secret: SecretKey) -> None:
-        self._sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
+        if _HAVE_PYCA:
+            self._sk = Ed25519PrivateKey.from_private_bytes(secret.seed)
+            self._signer = None
+        else:
+            self._sk = None
+            self._signer = _gated_signer(secret.seed)
 
     async def request_signature(self, digest: Digest) -> Signature:
-        return Signature(self._sk.sign(digest.data))
+        if self._sk is not None:
+            return Signature(self._sk.sign(digest.data))
+        return Signature(self._signer.sign(digest.data))
 
 
 __all__ = [

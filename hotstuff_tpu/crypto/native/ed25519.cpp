@@ -16,6 +16,7 @@
 
 #include <cstdint>
 #include <cstring>
+#include <mutex>
 #include <vector>
 
 typedef unsigned __int128 u128;
@@ -306,6 +307,70 @@ static bool pt_decompress(pt& r, const uint8_t enc[32]) {
     return true;
 }
 
+// 1/z = z^(p-2) = z^(2^255 - 21): the standard curve25519 addition chain.
+static void fe_invert(fe& r, const fe& z) {
+    fe z2, z9, z11, z_5_0, z_10_0, z_20_0, z_40_0, z_50_0, z_100_0, z_200_0, t;
+    fe_sq(z2, z);
+    fe_sqk(t, z2, 2);
+    fe_mul(z9, t, z);
+    fe_mul(z11, z9, z2);
+    fe_sq(t, z11);
+    fe_mul(z_5_0, t, z9);
+    fe_sqk(t, z_5_0, 5);
+    fe_mul(z_10_0, t, z_5_0);
+    fe_sqk(t, z_10_0, 10);
+    fe_mul(z_20_0, t, z_10_0);
+    fe_sqk(t, z_20_0, 20);
+    fe_mul(z_40_0, t, z_20_0);
+    fe_sqk(t, z_40_0, 10);
+    fe_mul(z_50_0, t, z_10_0);
+    fe_sqk(t, z_50_0, 50);
+    fe_mul(z_100_0, t, z_50_0);
+    fe_sqk(t, z_100_0, 100);
+    fe_mul(z_200_0, t, z_100_0);
+    fe_sqk(t, z_200_0, 50);
+    fe_mul(t, t, z_50_0);
+    fe_sqk(t, t, 5);
+    fe_mul(r, t, z11);
+}
+
+static void pt_compress(uint8_t out[32], const pt& p) {
+    fe zinv, x, y;
+    fe_invert(zinv, p.z);
+    fe_mul(x, p.x, zinv);
+    fe_mul(y, p.y, zinv);
+    fe_tobytes(out, y);
+    out[31] |= (uint8_t)(fe_parity(x) << 7);
+}
+
+// Fixed-base scalar multiplication: 4-bit radix-16 comb over a
+// precomputed table of d * 16^w * B (w in [0, 64), d in [1, 15]), built
+// once per process. Each call is then 63 unified additions and no
+// doublings. Variable-time in the scalar (table indexing by digit) —
+// acceptable for this research testbed; noted in the Python binding.
+static const uint8_t B_ENC[32] = {
+    0x58, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66,
+    0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66, 0x66};
+
+static constexpr int BASE_WINDOWS = 64;  // ceil(256 / 4)
+static pt g_base_table[BASE_WINDOWS * 15];
+static std::once_flag g_base_table_once;
+
+static void build_base_table() {
+    pt window_base;
+    pt_decompress(window_base, B_ENC);
+    for (int w = 0; w < BASE_WINDOWS; w++) {
+        pt acc = window_base;
+        for (int d = 1; d <= 15; d++) {
+            g_base_table[w * 15 + (d - 1)] = acc;
+            if (d < 15) pt_add(acc, acc, window_base);
+        }
+        // next window base: 16^{w+1} B = 16 * (16^w B)
+        for (int i = 0; i < 4; i++) pt_double(window_base, window_base);
+    }
+}
+
 extern "C" {
 
 // c-bit window starting at bit offset (byte-unaligned reads via memcpy).
@@ -401,10 +466,13 @@ int hs_ed25519_msm_is_identity(const uint8_t* encodings,
 // pre_xy is m*64 bytes of canonical affine x|y (as written by
 // hs_ed25519_decompress_check); flags[i] != 0 selects it over
 // encodings+32*i. Semantics otherwise identical: 1 iff all points valid
-// and 8 * sum(s_i * P_i) == identity.
+// and 8 * sum(s_i * P_i) == identity. With cofactored == 0 the final
+// multiply-by-8 is skipped (sum itself must be the identity) — the
+// cofactorless equation of dalek verify_strict / OpenSSL, used for
+// single-signature verification when no OpenSSL binding is installed.
 int hs_ed25519_msm_signed(const uint8_t* encodings, const uint8_t* pre_xy,
                           const uint8_t* flags, const uint8_t* scalars,
-                          uint64_t m, int c) {
+                          uint64_t m, int c, int cofactored) {
     if (encodings == nullptr || scalars == nullptr || m == 0) return -1;
     if (c < 1) c = 1;
     if (c > 12) c = 12;
@@ -488,10 +556,37 @@ int hs_ed25519_msm_signed(const uint8_t* encodings, const uint8_t* pre_xy,
         }
     }
 
-    pt_double(acc, acc);
-    pt_double(acc, acc);
-    pt_double(acc, acc);
+    if (cofactored) {
+        pt_double(acc, acc);
+        pt_double(acc, acc);
+        pt_double(acc, acc);
+    }
     return pt_is_identity(acc) ? 1 : 0;
+}
+
+// out32 = compress(scalar * B). scalar: 32 bytes little-endian, already
+// reduced mod L by the caller (< 2^253). Returns 1; -1 on null args.
+// Powers Ed25519 signing and public-key derivation when the environment
+// has no OpenSSL-backed crypto package (the Python side does the SHA-512
+// and mod-L scalar arithmetic, exactly like the batch-verify split).
+int hs_ed25519_scalarmult_base(const uint8_t* scalar, uint8_t* out32) {
+    if (scalar == nullptr || out32 == nullptr) return -1;
+    std::call_once(g_base_table_once, build_base_table);
+    pt acc = PT_IDENTITY;
+    bool started = false;
+    for (int w = 0; w < BASE_WINDOWS; w++) {
+        int d = (scalar[w >> 1] >> ((w & 1) * 4)) & 0xf;
+        if (d == 0) continue;
+        const pt& e = g_base_table[w * 15 + (d - 1)];
+        if (!started) {
+            acc = e;
+            started = true;
+        } else {
+            pt_add(acc, acc, e);
+        }
+    }
+    pt_compress(out32, acc);
+    return 1;
 }
 
 // Single-point decompression probe (for tests): returns 1 if the encoding

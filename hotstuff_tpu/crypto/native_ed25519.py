@@ -75,7 +75,13 @@ def _load():
             ctypes.c_char_p,  # flags (m), may be None
             ctypes.c_char_p,  # scalars (m*32)
             ctypes.c_uint64,
-            ctypes.c_int,
+            ctypes.c_int,  # window width
+            ctypes.c_int,  # cofactored (0 = strict/cofactorless sum)
+        ]
+        lib.hs_ed25519_scalarmult_base.restype = ctypes.c_int
+        lib.hs_ed25519_scalarmult_base.argtypes = [
+            ctypes.c_char_p,
+            ctypes.c_char_p,
         ]
         _lib = lib
     return _lib
@@ -99,6 +105,20 @@ def native_available(build: bool = True) -> bool:
 def decompress_check(encoding: bytes) -> bool:
     """Native single-point decompression probe (test hook)."""
     return _load().hs_ed25519_decompress_check(encoding, None) == 1
+
+
+def scalarmult_base_native(scalar: int) -> bytes:
+    """Compressed encoding of ``scalar * B`` (``scalar`` already reduced
+    mod L). Powers signing/public-key derivation when the ``cryptography``
+    package is unavailable. Variable-time in the scalar (comb indexing) —
+    fine for this research testbed, noted here for production readers."""
+    out = ctypes.create_string_buffer(32)
+    rc = _load().hs_ed25519_scalarmult_base(
+        scalar.to_bytes(32, "little"), out
+    )
+    if rc != 1:
+        raise ValueError("native scalarmult rejected arguments")
+    return bytes(out.raw)
 
 
 # Decompressed-point cache: committee public keys recur in every QC this
@@ -187,6 +207,44 @@ def verify_batch_native(msgs, pubs, sigs, rng=None) -> bool:
         bytes(scalars),
         m,
         _signed_window(m),
+        1,
+    )
+    if rc < 0:
+        raise ValueError("native ed25519 engine rejected arguments")
+    return rc == 1
+
+
+def verify_single_strict_native(msg: bytes, pub: bytes, sig: bytes) -> bool:
+    """COFACTORLESS single verification: s B - R - h A == identity — the
+    exact equation OpenSSL / dalek ``verify_strict`` check, evaluated as
+    one 3-point MSM on the native engine. Used for ``Signature.verify``
+    when the ``cryptography`` package is unavailable, so gated and
+    non-gated processes share one strict acceptance set. The caller is
+    responsible for the small-order/canonical-encoding rejections."""
+    if len(sig) != 64 or len(pub) != 32:
+        return False
+    r_enc, s_bytes = sig[:32], sig[32:]
+    s = int.from_bytes(s_bytes, "little")
+    if s >= L:  # non-canonical s: reject (RFC 8032 / dalek / OpenSSL)
+        return False
+    if (int.from_bytes(pub, "little") & _HALF_MASK) >= P:
+        return False
+    if (int.from_bytes(r_enc, "little") & _HALF_MASK) >= P:
+        return False
+    xy = _cached_xy(bytes(pub))
+    if xy is None:
+        return False
+    h = int.from_bytes(hashlib.sha512(r_enc + pub + msg).digest(), "little") % L
+    encodings = r_enc + pub + _B_ENC
+    pre_xy = bytes(64) + xy + _cached_xy(_B_ENC)
+    flags = bytes([0, 1, 1])
+    scalars = (
+        (L - 1).to_bytes(32, "little")  # -1 * R
+        + ((L - h) % L).to_bytes(32, "little")  # -h * A
+        + s.to_bytes(32, "little")  # s * B
+    )
+    rc = _load().hs_ed25519_msm_signed(
+        encodings, pre_xy, flags, scalars, 3, _signed_window(3), 0
     )
     if rc < 0:
         raise ValueError("native ed25519 engine rejected arguments")

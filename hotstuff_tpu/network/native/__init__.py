@@ -31,7 +31,9 @@ import random
 import socket
 import struct
 import subprocess
+import threading
 import time
+from concurrent.futures import ThreadPoolExecutor
 
 log = logging.getLogger("network")
 
@@ -52,17 +54,27 @@ RECV_LOW_WATER = 512
 # Dispatch-progress report granularity (frames per hs_net_consumed call).
 _CONSUMED_BATCH = 32
 
-# How long a failed hostname lookup suppresses further (blocking)
-# getaddrinfo attempts before the next send retries it. Doubles per
-# consecutive failure up to the cap: against a persistently-bad name a
-# flat window would re-run a blocking getaddrinfo (up to ~10 s against a
-# dropping resolver) on the event-loop thread every period, forever.
+# How long a failed hostname lookup suppresses further getaddrinfo
+# attempts before the next send retries it. Doubles per consecutive
+# failure up to the cap. Lookups run on a dedicated worker thread (the
+# event loop never blocks on the resolver), so the cap can be SHORT: a
+# peer whose name resolves again is back within a minute, not ten
+# (round-5 advisor finding — the old 600 s cap meant a transient
+# resolver outage cost a correct peer for up to 10 minutes).
 _RESOLVE_RETRY_S = 15.0
-_RESOLVE_RETRY_MAX_S = 600.0
+_RESOLVE_RETRY_MAX_S = 60.0
+# Sends parked per unresolved hostname while its lookup is in flight;
+# beyond this they drop (best-effort semantics, same as a down peer).
+_RESOLVE_PARK_CAP = 1024
 
 _EV_RECV = 1
 _EV_ACKED = 2
 _EV_GONE = 3
+_EV_VOTE_BATCH = 4
+
+# Fixed Vote wire frame length (consensus/messages.py layout) — the unit
+# EV_VOTE_BATCH payloads are sliced into.
+VOTE_WIRE_LEN = 137
 
 _HDR = struct.Struct("<BQQI")  # type, a, b, payload_len
 
@@ -106,6 +118,19 @@ def _load():
         lib.hs_net_consumed.restype = None
         lib.hs_net_consumed.argtypes = [
             ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
+        ]
+        lib.hs_net_set_vote_filter.restype = None
+        lib.hs_net_set_vote_filter.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_char_p, ctypes.c_uint32
+        ]
+        lib.hs_net_set_round.restype = None
+        lib.hs_net_set_round.argtypes = [
+            ctypes.c_void_p, ctypes.c_uint64, ctypes.c_uint64
+        ]
+        lib.hs_net_broadcast.restype = None
+        lib.hs_net_broadcast.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
+            ctypes.c_char_p, ctypes.c_uint32,
         ]
         lib.hs_net_close_listener.restype = None
         lib.hs_net_close_listener.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
@@ -164,8 +189,17 @@ class NativeTransport:
         # backoff used for the NEXT failure). Negative results must not
         # be permanent — a resolver down at boot would cost a correct
         # peer for the whole process lifetime — but retries back off so
-        # a persistently-bad name doesn't stall the loop every period.
+        # a persistently-bad name isn't looked up on every send.
         self._resolve_retry_at: dict[str, tuple[float, float]] = {}
+        # getaddrinfo runs on this worker, NEVER on the event-loop thread
+        # (a dropping resolver blocks ~10 s per call — with the short
+        # 60 s retry cap that would stall the loop every minute). Sends
+        # to a not-yet-resolved name park here and are flushed by the
+        # worker (hs_net_send is thread-safe: the C++ command queue is
+        # mutex-guarded).
+        self._resolve_lock = threading.Lock()
+        self._resolve_pool: ThreadPoolExecutor | None = None
+        self._parked_sends: dict[str, list[tuple[int, bytes, bool, int]]] = {}
 
     @classmethod
     def get(cls) -> "NativeTransport":
@@ -203,33 +237,33 @@ class NativeTransport:
         self._next_msg_id += 1
         return mid
 
-    def _resolve(self, host: str) -> str | None:
-        """IPv4 literal for ``host`` (the C++ loop speaks inet_pton only).
-
-        Hostnames are resolved once and cached — committee files name a
-        small fixed peer set, so at most one blocking getaddrinfo per
-        distinct name per process (same lookup the asyncio transport does
-        inside ``open_connection``, which silently diverged before).
-        Failed lookups are cached only for ``_RESOLVE_RETRY_S`` seconds:
-        a transient resolver outage (e.g. DNS not yet up at boot) must
-        not permanently cost connectivity to a correct peer, but we also
-        must not re-run a BLOCKING getaddrinfo on the loop thread for
-        every single send while the name stays bad."""
-        if host in self._resolved:
-            cached = self._resolved[host]
-            if cached is not None:
-                return cached
-            # Negative entry: honor the retry deadline, then re-resolve.
-            deadline, _ = self._resolve_retry_at.get(host, (0.0, 0.0))
-            if time.monotonic() < deadline:
-                return None
-            del self._resolved[host]
+    def _resolve_fast(self, host: str) -> str | None:
+        """Non-blocking resolution: IPv4 literals and cached names only.
+        Returns the literal, or None when the name is unknown (caller
+        decides whether to park the send and kick the worker)."""
+        cached = self._resolved.get(host)
+        if cached is not None:
+            return cached
         try:
             ipaddress.IPv4Address(host)
-            self._resolved[host] = host
-            return host
         except ValueError:
-            pass
+            return None
+        self._resolved[host] = host
+        return host
+
+    def _resolve_blocking(self, host: str) -> str | None:
+        """One getaddrinfo for ``host``, honoring the negative-cache
+        backoff. BLOCKING — runs on the resolver worker (or synchronously
+        at listen/startup time, where a stalled loop cannot exist yet).
+        Failed lookups are cached only for ``_RESOLVE_RETRY_S`` seconds
+        (doubling per consecutive failure, capped at 60 s): a transient
+        resolver outage must not permanently cost a correct peer."""
+        fast = self._resolve_fast(host)
+        if fast is not None:
+            return fast
+        deadline, _ = self._resolve_retry_at.get(host, (0.0, 0.0))
+        if time.monotonic() < deadline:
+            return None  # negative entry still fresh: don't re-query
         try:
             infos = socket.getaddrinfo(
                 host, None, socket.AF_INET, socket.SOCK_STREAM
@@ -244,7 +278,6 @@ class NativeTransport:
                 "dropping sends to it for the next %ds", host, exc,
                 int(backoff),
             )
-            self._resolved[host] = None
             self._resolve_retry_at[host] = (
                 time.monotonic() + backoff,
                 min(backoff * 2, _RESOLVE_RETRY_MAX_S),
@@ -254,10 +287,52 @@ class NativeTransport:
         self._resolve_retry_at.pop(host, None)  # reset failure backoff
         return addr
 
+    def _park_send(
+        self, host: str, port: int, data: bytes, reliable: bool, msg_id: int
+    ) -> None:
+        """Queue a send behind its hostname's in-flight lookup and make
+        sure a worker lookup is scheduled. The worker flushes (or drops)
+        the parked sends when the lookup settles."""
+        with self._resolve_lock:
+            parked = self._parked_sends.get(host)
+            first = parked is None
+            if first:
+                parked = self._parked_sends[host] = []
+            if len(parked) < _RESOLVE_PARK_CAP:
+                parked.append((port, data, reliable, msg_id))
+            if self._resolve_pool is None:
+                self._resolve_pool = ThreadPoolExecutor(
+                    max_workers=1, thread_name_prefix="hsnet-dns"
+                )
+        if first:
+            self._resolve_pool.submit(self._resolve_and_flush, host)
+
+    def _resolve_and_flush(self, host: str) -> None:
+        # Worker thread. A still-backing-off name resolves to None and
+        # its parked sends drop — observably a down peer, exactly the
+        # asyncio transport's retry-forever behavior from the caller's
+        # side (reliable ACK futures stay pending until cancelled).
+        try:
+            addr = self._resolve_blocking(host)
+        except Exception:  # noqa: BLE001 — never kill the worker
+            log.exception("resolver worker failed for %r", host)
+            addr = None
+        with self._resolve_lock:
+            parked = self._parked_sends.pop(host, [])
+        if addr is None:
+            return
+        for port, data, reliable, msg_id in parked:
+            self._lib.hs_net_send(
+                self._ctx, addr.encode(), ctypes.c_uint16(port),
+                data, len(data), int(reliable), ctypes.c_uint64(msg_id),
+            )
+
     def listen(
         self, receiver: "NativeReceiver", host: str, port: int, auto_ack: bool
     ) -> int:
-        resolved = self._resolve(host)
+        # Startup path: blocking resolution is fine (no live loop traffic
+        # behind us) and listen errors must be synchronous.
+        resolved = self._resolve_blocking(host)
         if resolved is None:
             raise OSError(f"cannot resolve listen address {host!r}")
         lid = self._lib.hs_net_listen(
@@ -284,9 +359,22 @@ class NativeTransport:
             self._ctx, ctypes.c_uint64(lid), int(paused)
         )
 
+    def set_vote_filter(self, lid: int, authors: list[bytes]) -> None:
+        """Push the committee table down to the C++ vote pre-stage."""
+        packed = b"".join(authors)
+        assert len(packed) == 32 * len(authors), "authors must be 32-byte keys"
+        self._lib.hs_net_set_vote_filter(
+            self._ctx, ctypes.c_uint64(lid), packed, len(authors)
+        )
+
+    def set_round(self, lid: int, round_: int) -> None:
+        self._lib.hs_net_set_round(
+            self._ctx, ctypes.c_uint64(lid), ctypes.c_uint64(round_)
+        )
+
     def stats(self) -> dict[str, int]:
         """Loop-thread state snapshot (tests / operational visibility)."""
-        out = (ctypes.c_uint64 * 5)()
+        out = (ctypes.c_uint64 * 7)()
         self._lib.hs_net_stats(self._ctx, out)
         return {
             "pending": out[0],
@@ -294,6 +382,8 @@ class NativeTransport:
             "cancelled": out[2],
             "out_conns": out[3],
             "in_conns": out[4],
+            "votes_batched": out[5],
+            "votes_dropped": out[6],
         }
 
     def send(
@@ -301,16 +391,36 @@ class NativeTransport:
         reliable: bool = False, msg_id: int = 0,
     ) -> None:
         host, port = address
-        resolved = self._resolve(host)
+        resolved = self._resolve_fast(host)
         if resolved is None:
-            # Logged by _resolve. Observable behavior matches a
-            # permanently-down peer (the asyncio transport's retry-forever
-            # case): the ACK future stays pending until the caller drops
-            # it, which cancels and reclaims the back-pressure slot.
+            # Unknown hostname: park behind a worker-thread lookup (the
+            # event loop must never block on getaddrinfo). If the name
+            # stays bad the parked sends drop — observably a down peer;
+            # reliable ACK futures stay pending until the caller cancels.
+            self._park_send(host, port, data, reliable, msg_id)
             return
         self._lib.hs_net_send(
             self._ctx, resolved.encode(), ctypes.c_uint16(port),
             data, len(data), int(reliable), ctypes.c_uint64(msg_id),
+        )
+
+    def broadcast(
+        self, addresses: list[tuple[str, int]], data: bytes
+    ) -> None:
+        """Best-effort fan-out: ONE command into the loop thread; the C++
+        side builds the frame once and queues it per peer."""
+        tokens = []
+        for host, port in addresses:
+            resolved = self._resolve_fast(host)
+            if resolved is None:
+                self._park_send(host, port, data, False, 0)
+                continue
+            tokens.append(f"{resolved}:{port}")
+        if not tokens:
+            return
+        packed = " ".join(tokens).encode()
+        self._lib.hs_net_broadcast(
+            self._ctx, packed, len(packed), data, len(data)
         )
 
     def cancel(self, msg_id: int) -> None:
@@ -347,6 +457,10 @@ class NativeTransport:
                     receiver = self._listeners.get(a)
                     if receiver is not None:
                         receiver._enqueue(b, payload)
+                elif etype == _EV_VOTE_BATCH:
+                    receiver = self._listeners.get(a)
+                    if receiver is not None:
+                        receiver._enqueue_votes(b, payload)
                 elif etype == _EV_ACKED:
                     fut = self._acks.pop(a, None)
                     if fut is not None and not fut.done():
@@ -383,7 +497,12 @@ class _AckedWriter:
 
 class NativeReceiver:
     """Drop-in for ``network.Receiver``: one dispatch task drains the
-    inbound frame queue sequentially (actor semantics preserved)."""
+    inbound frame queue sequentially (actor semantics preserved).
+
+    With a vote pre-stage configured (``configure_vote_prestage``), the
+    C++ loop delivers pre-validated votes as aggregated batches; the
+    dispatch task hands each batch to ``handler.dispatch_votes`` (falling
+    back to per-frame ``dispatch`` for handlers without one)."""
 
     def __init__(
         self, address: tuple[str, int], handler, auto_ack: bool = False
@@ -393,7 +512,8 @@ class NativeReceiver:
         self.auto_ack = auto_ack
         self._transport: NativeTransport | None = None
         self._lid: int | None = None
-        self._queue: asyncio.Queue[tuple[int, bytes]] = asyncio.Queue()
+        # ("frame", conn_id, frame) | ("votes", count, packed_frames)
+        self._queue: asyncio.Queue[tuple[str, int, bytes]] = asyncio.Queue()
         self._task: asyncio.Task | None = None
 
     @classmethod
@@ -412,7 +532,23 @@ class NativeReceiver:
         return self
 
     def _enqueue(self, conn_id: int, frame: bytes) -> None:
-        self._queue.put_nowait((conn_id, frame))
+        self._queue.put_nowait(("frame", conn_id, frame))
+
+    def _enqueue_votes(self, count: int, packed: bytes) -> None:
+        self._queue.put_nowait(("votes", count, packed))
+
+    def configure_vote_prestage(self, authors: list[bytes]) -> None:
+        """Enable the C++ vote pre-stage with the committee's 32-byte
+        public keys (seat table). Votes are then length-validated,
+        seat-checked, round-gated and deduped on the loop thread and
+        delivered as aggregated batches — a filter only; full Signature
+        verification stays in the consensus core."""
+        self._transport.set_vote_filter(self._lid, authors)
+
+    def set_round(self, round_: int) -> None:
+        """Advance the pre-stage's stale-round cutoff (call on round
+        advance; monotonic)."""
+        self._transport.set_round(self._lid, round_)
 
     async def _dispatch_loop(self) -> None:
         acked = _AckedWriter()
@@ -423,13 +559,36 @@ class NativeReceiver:
             ):
                 self._transport.consumed(self._lid, undisclosed)
                 undisclosed = 0
-            conn_id, frame = await self._queue.get()
+            kind, a, payload = await self._queue.get()
+            if kind == "votes":
+                frames = [
+                    payload[i : i + VOTE_WIRE_LEN]
+                    for i in range(0, len(payload), VOTE_WIRE_LEN)
+                ]
+                dispatch_votes = getattr(self.handler, "dispatch_votes", None)
+                try:
+                    if dispatch_votes is not None:
+                        await dispatch_votes(frames)
+                    else:
+                        # Handler without a batch path: degrade to the
+                        # per-frame contract (votes only arrive on
+                        # auto-ack listeners, so the writer is a no-op).
+                        for frame in frames:
+                            await self.handler.dispatch(acked, frame)
+                except Exception:
+                    log.exception(
+                        "vote batch handler error (native receiver %s)",
+                        self.address,
+                    )
+                undisclosed += len(frames)
+                continue
+            conn_id = a
             writer = (
                 acked if self.auto_ack
                 else _NativeFramedWriter(self._transport, conn_id)
             )
             try:
-                await self.handler.dispatch(writer, frame)
+                await self.handler.dispatch(writer, payload)
             except Exception:
                 log.exception("handler error (native receiver %s)", self.address)
             undisclosed += 1
@@ -452,15 +611,14 @@ class NativeSimpleSender:
         NativeTransport.get().send(address, data, reliable=False)
 
     def broadcast(self, addresses: list[tuple[str, int]], data: bytes) -> None:
-        for addr in addresses:
-            self.send(addr, data)
+        # Coalesced: one command into the loop thread, one frame build.
+        NativeTransport.get().broadcast(addresses, data)
 
     def lucky_broadcast(
         self, addresses: list[tuple[str, int]], data: bytes, nodes: int
     ) -> None:
         picked = self._rng.sample(addresses, min(nodes, len(addresses)))
-        for addr in picked:
-            self.send(addr, data)
+        NativeTransport.get().broadcast(picked, data)
 
     def shutdown(self) -> None:
         pass  # connections are owned by the process-wide transport

@@ -27,6 +27,7 @@
 #include <arpa/inet.h>
 #include <errno.h>
 #include <fcntl.h>
+#include <stdlib.h>
 #include <netinet/in.h>
 #include <netinet/tcp.h>
 #include <string.h>
@@ -36,10 +37,13 @@
 #include <time.h>
 #include <unistd.h>
 
+#include <sys/uio.h>
+
 #include <atomic>
 #include <condition_variable>
 #include <cstdint>
 #include <deque>
+#include <map>
 #include <memory>
 #include <mutex>
 #include <string>
@@ -78,7 +82,24 @@ enum : uint8_t {
   EV_RECV = 1,    // a=listener_id, b=conn_id, payload=frame
   EV_ACKED = 2,   // a=msg_id, payload=ACK bytes
   EV_GONE = 3,    // a=listener_id, b=conn_id (inbound connection closed)
+  // a=listener_id, b=vote count, payload=count fixed-layout vote frames
+  // (the vote pre-stage): ONE Python wakeup per poll cycle for the whole
+  // fan-in, not one per frame.
+  EV_VOTE_BATCH = 4,
 };
+
+// Fixed wire layout of a consensus Vote (consensus/messages.py):
+//   u8 tag=1 | 32B block hash | u64 LE round | 32B author | 64B signature
+// The pre-stage decodes round/author straight from these offsets; any
+// frame that is not exactly this shape flows through the normal EV_RECV
+// path and Python's full decoder.
+constexpr size_t VOTE_WIRE_LEN = 137;
+constexpr uint8_t VOTE_TAG = 1;
+constexpr size_t VOTE_ROUND_OFF = 33;
+constexpr size_t VOTE_AUTHOR_OFF = 41;
+// Mirrors Core.MAX_ROUND_LOOKAHEAD: votes fabricated for far-future
+// rounds are dropped before they can allocate dedupe state.
+constexpr uint64_t VOTE_ROUND_LOOKAHEAD = 1000;
 
 struct Event {
   uint8_t type;
@@ -98,6 +119,9 @@ enum : uint8_t {
   CMD_RESUME_LISTENER = 9,
   CMD_STATS = 10,  // fill a StatsReq on the loop thread (tests/ops)
   CMD_CONSUMED = 11,  // Python dispatched n frames of a listener
+  CMD_SET_VOTE_FILTER = 12,  // listener_id, payload = n*32 author keys
+  CMD_SET_ROUND = 13,        // listener_id, count = stale-round cutoff
+  CMD_BROADCAST = 14,        // host = "ip:port ip:port ...", payload once
 };
 
 // Loop-thread state snapshot, serviced as a command so no lock covers the
@@ -111,6 +135,8 @@ struct StatsReq {
   uint64_t cancelled = 0;  // parked cancel markers
   uint64_t out_conns = 0;
   uint64_t in_conns = 0;
+  uint64_t votes_batched = 0;  // vote frames delivered via EV_VOTE_BATCH
+  uint64_t votes_dropped = 0;  // vote frames dropped by the pre-stage
 };
 
 struct Command {
@@ -177,6 +203,23 @@ struct Listener {
   uint32_t high = 0;  // 0 = unbounded (no budget)
   uint32_t low = 0;
   bool paused() const { return cmd_paused || flood_paused; }
+
+  // -- vote pre-stage (hs_net_set_vote_filter) --
+  // The pre-stage is a FILTER, never a trust root: everything it admits
+  // is re-checked (round, authority, signature) by the consensus core;
+  // it may only drop frames the core would provably drop cheaply —
+  // unknown seats, stale/far-future rounds, and byte-identical resends
+  // of a seat's latest vote.
+  bool vf_enabled = false;
+  std::unordered_map<std::string, uint32_t> vf_seats;  // 32B key -> seat
+  uint64_t vf_round = 0;  // stale cutoff, pushed down on round advance
+  // round -> seat -> latest admitted vote frame (dedupe by identity);
+  // ordered by round so advancing the cutoff GCs with an erase-range.
+  std::map<uint64_t, std::unordered_map<uint32_t, std::string>> vf_seen;
+  // Admitted votes accumulated during the current poll cycle, flushed as
+  // ONE EV_VOTE_BATCH per cycle.
+  std::string vote_buf;
+  uint64_t vote_count = 0;
 };
 
 struct AddrKey {
@@ -376,6 +419,7 @@ class NetCore {
           handle_outbound(tag & ~TAG_OUT, flags);
         }
       }
+      flush_vote_batches();
       // Reconnect timers: disconnected reliable connections redial on
       // their backoff schedule whether or not traffic is queued (the
       // reference's keep_alive loop does the same).
@@ -469,6 +513,35 @@ class NetCore {
         case CMD_SEND_SIMPLE:
           send_simple(c.host, c.port, c.payload);
           break;
+        case CMD_BROADCAST:
+          broadcast_simple(c.host, c.payload);
+          break;
+        case CMD_SET_VOTE_FILTER: {
+          auto it = listeners_.find(c.id);
+          if (it != listeners_.end()) {
+            Listener& l = it->second;
+            l.vf_seats.clear();
+            l.vf_seen.clear();
+            for (size_t i = 0; i + 32 <= c.payload.size(); i += 32) {
+              l.vf_seats.emplace(c.payload.substr(i, 32), uint32_t(i / 32));
+            }
+            l.vf_enabled = !l.vf_seats.empty();
+          }
+          break;
+        }
+        case CMD_SET_ROUND: {
+          auto it = listeners_.find(c.id);
+          if (it != listeners_.end()) {
+            Listener& l = it->second;
+            if (c.count > l.vf_round) {
+              l.vf_round = c.count;
+              // GC dedupe state for rounds now below the cutoff.
+              l.vf_seen.erase(l.vf_seen.begin(),
+                              l.vf_seen.lower_bound(c.count));
+            }
+          }
+          break;
+        }
         case CMD_SEND_RELIABLE:
           send_reliable(c.host, c.port, c.id, c.payload);
           break;
@@ -538,6 +611,8 @@ class NetCore {
           s->cancelled = cancelled_.size();
           s->out_conns = out_conns_.size();
           s->in_conns = in_conns_.size();
+          s->votes_batched = votes_batched_;
+          s->votes_dropped = votes_dropped_;
           {
             // notify under the lock: after the unlock the waiter may
             // (spurious wakeup) observe done and destroy the
@@ -660,13 +735,22 @@ class NetCore {
           return;
         }
         if (c.inbuf.size() - off - 4 < len) break;
-        emit(Event{EV_RECV, c.listener_id, id,
-                   c.inbuf.substr(off + 4, len)});
+        bool charge = true;
+        if (l != nullptr && l->vf_enabled && len == VOTE_WIRE_LEN &&
+            uint8_t(c.inbuf[off + 4]) == VOTE_TAG) {
+          charge = prestage_vote(*l, c.inbuf.data() + off + 4);
+        } else {
+          emit(Event{EV_RECV, c.listener_id, id,
+                     c.inbuf.substr(off + 4, len)});
+        }
         if (c.auto_ack) {
+          // ACK every frame — including pre-stage drops: the asyncio
+          // receiver ACKs before its (Python-side) drop too, so sender
+          // back-pressure accounting is transport-independent.
           frame_append(c.outbuf, reinterpret_cast<const uint8_t*>("Ack"), 3);
         }
         off += 4 + len;
-        if (l != nullptr && l->high != 0) {
+        if (charge && l != nullptr && l->high != 0) {
           l->outstanding++;
           if (!l->flood_paused && l->outstanding >= l->high) {
             l->flood_paused = true;
@@ -685,6 +769,50 @@ class NetCore {
       }
     }
     if (flags & EPOLLOUT) flush_inbound(c);
+  }
+
+  // Classify one vote frame (VOTE_WIRE_LEN bytes at ``frame``) against
+  // the listener's committee table. Admitted votes accumulate in the
+  // listener's per-cycle batch buffer; returns true iff the frame was
+  // admitted (and should charge the outstanding-event budget). Drops are
+  // exactly the core's cheap pre-verification drops: unknown seat, round
+  // below the pushed-down cutoff or beyond the lookahead bound, and a
+  // byte-identical resend of the seat's latest admitted vote. A DIFFERENT
+  // payload for an occupied seat always passes through — spoof/
+  // equivocation arbitration (individual verification, re-seat, ejection)
+  // stays in the core, where the Signature semantics live.
+  bool prestage_vote(Listener& l, const char* frame) {
+    uint64_t round;
+    memcpy(&round, frame + VOTE_ROUND_OFF, 8);  // wire is little-endian
+    auto seat_it = l.vf_seats.find(std::string(frame + VOTE_AUTHOR_OFF, 32));
+    if (seat_it == l.vf_seats.end() || round < l.vf_round ||
+        round > l.vf_round + VOTE_ROUND_LOOKAHEAD) {
+      votes_dropped_++;
+      return false;
+    }
+    auto& seat_map = l.vf_seen[round];
+    auto prev = seat_map.find(seat_it->second);
+    if (prev != seat_map.end() &&
+        prev->second.compare(0, VOTE_WIRE_LEN, frame, VOTE_WIRE_LEN) == 0) {
+      votes_dropped_++;  // identical resend of this seat's latest vote
+      return false;
+    }
+    seat_map[seat_it->second] = std::string(frame, VOTE_WIRE_LEN);
+    l.vote_buf.append(frame, VOTE_WIRE_LEN);
+    l.vote_count++;
+    votes_batched_++;
+    return true;
+  }
+
+  // One aggregated event per listener per poll cycle: the whole vote
+  // fan-in of the cycle costs Python a single wakeup + decode loop.
+  void flush_vote_batches() {
+    for (auto& [lid, l] : listeners_) {
+      if (l.vote_count == 0) continue;
+      emit(Event{EV_VOTE_BATCH, lid, l.vote_count, std::move(l.vote_buf)});
+      l.vote_buf.clear();  // moved-from: reset to a known state
+      l.vote_count = 0;
+    }
   }
 
   void flush_inbound(InConn& c) {
@@ -739,6 +867,37 @@ class NetCore {
     c.pending.push_back(std::move(m));
     if (c.fd < 0 && !c.connecting) start_connect(c);
     if (c.fd >= 0 && !c.connecting) pump_out(c);
+  }
+
+  // One command for a whole best-effort broadcast: the frame is built
+  // ONCE (length prefix + payload) and queued per peer, instead of one
+  // Python->C crossing and one frame_append per peer. ``addrs`` is
+  // space-separated "ip:port" tokens (resolved by the Python side).
+  void broadcast_simple(const std::string& addrs, const std::string& payload) {
+    std::string frame;
+    frame_append(frame, reinterpret_cast<const uint8_t*>(payload.data()),
+                 uint32_t(payload.size()));
+    size_t pos = 0;
+    while (pos < addrs.size()) {
+      size_t sp = addrs.find(' ', pos);
+      if (sp == std::string::npos) sp = addrs.size();
+      size_t colon = addrs.rfind(':', sp);
+      if (colon != std::string::npos && colon > pos) {
+        std::string host = addrs.substr(pos, colon - pos);
+        uint16_t port =
+            uint16_t(strtoul(addrs.c_str() + colon + 1, nullptr, 10));
+        OutConn& c = out_conn(host, port, false);
+        if (c.pending.size() < SIMPLE_QUEUE_CAP) {
+          PendingMsg m;
+          m.msg_id = 0;
+          m.frame = frame;  // shared encode: one build, N queued copies
+          c.pending.push_back(std::move(m));
+          if (c.fd < 0 && !c.connecting) start_connect(c);
+          if (c.fd >= 0 && !c.connecting) pump_out(c);
+        }
+      }
+      pos = sp + 1;
+    }
   }
 
   void send_reliable(const std::string& host, uint16_t port, uint64_t msg_id,
@@ -830,30 +989,82 @@ class NetCore {
     }
   }
 
+  // Gathered write: the leftover staging buffer plus up to IOV_FRAMES
+  // pending frames go out in ONE writev per round trip — pre-serialized
+  // frames are never copied into a contiguous buffer on the happy path
+  // (only a short write's partial frame leaves a remainder in outbuf).
+  // Reliable frames enter ``inflight`` exactly when their bytes reach the
+  // socket, preserving FIFO ACK pairing across partial writes.
+  static constexpr int IOV_FRAMES = 63;
+
   void pump_out(OutConn& c) {
-    // Move pending frames into the staging buffer (reliable: track order
-    // in inflight for ACK pairing), then write as much as the socket
-    // accepts.
-    while (!c.pending.empty() && c.outbuf.size() < 1 << 20) {
-      PendingMsg m = std::move(c.pending.front());
-      c.pending.pop_front();
-      if (m.msg_id && cancelled_.count(m.msg_id)) {
-        cancelled_.erase(m.msg_id);
-        continue;
+    while (true) {
+      iovec iov[IOV_FRAMES + 1];
+      int iovcnt = 0;
+      size_t planned = 0;
+      if (!c.outbuf.empty()) {
+        iov[iovcnt++] = {c.outbuf.data(), c.outbuf.size()};
+        planned += c.outbuf.size();
       }
-      c.outbuf += m.frame;
-      if (c.reliable) c.inflight.push_back(std::move(m));
-    }
-    while (!c.outbuf.empty()) {
-      ssize_t w = write(c.fd, c.outbuf.data(), c.outbuf.size());
-      if (w > 0) {
-        c.outbuf.erase(0, size_t(w));
-      } else if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
-        break;
-      } else {
+      std::vector<PendingMsg> staged;
+      while (!c.pending.empty() && iovcnt + int(staged.size()) <= IOV_FRAMES &&
+             planned < (1u << 20)) {
+        PendingMsg m = std::move(c.pending.front());
+        c.pending.pop_front();
+        if (m.msg_id && cancelled_.count(m.msg_id)) {
+          cancelled_.erase(m.msg_id);
+          continue;
+        }
+        planned += m.frame.size();
+        staged.push_back(std::move(m));
+      }
+      for (size_t i = 0; i < staged.size(); i++) {
+        iov[iovcnt++] = {staged[i].frame.data(), staged[i].frame.size()};
+      }
+      if (planned == 0) break;
+      ssize_t w = writev(c.fd, iov, iovcnt);
+      if (w < 0 && (errno == EAGAIN || errno == EWOULDBLOCK)) {
+        w = 0;
+      } else if (w < 0) {
+        // Put the staged frames back for conn_failed's replay accounting
+        // (reliable) / drop (simple) — none of their bytes were written.
+        for (auto it = staged.rbegin(); it != staged.rend(); ++it) {
+          c.pending.push_front(std::move(*it));
+        }
         conn_failed(c);
         return;
       }
+      size_t remaining = size_t(w);
+      if (!c.outbuf.empty()) {
+        size_t take = std::min(remaining, c.outbuf.size());
+        c.outbuf.erase(0, take);
+        remaining -= take;
+      }
+      size_t i = 0;
+      for (; i < staged.size(); i++) {
+        if (c.outbuf.empty() && remaining >= staged[i].frame.size()) {
+          remaining -= staged[i].frame.size();
+          if (c.reliable) c.inflight.push_back(std::move(staged[i]));
+          continue;
+        }
+        break;
+      }
+      if (i < staged.size()) {
+        if (c.outbuf.empty() && remaining > 0) {
+          // Partially written frame: its unwritten tail becomes the new
+          // staging buffer; the frame itself is on the wire (inflight).
+          c.outbuf.assign(staged[i].frame, remaining,
+                          staged[i].frame.size() - remaining);
+          if (c.reliable) c.inflight.push_back(std::move(staged[i]));
+          i++;
+        }
+        // Untouched frames return to the queue front, order preserved.
+        for (size_t j = staged.size(); j > i; j--) {
+          c.pending.push_front(std::move(staged[j - 1]));
+        }
+      }
+      if (size_t(w) < planned) break;  // kernel buffer full: wait for EPOLLOUT
+      if (c.pending.empty()) break;
     }
     epoll_event ev{};
     ev.events = EPOLLIN |
@@ -961,6 +1172,8 @@ class NetCore {
   uint64_t next_listener_id_ = 1;
   uint64_t next_conn_id_ = 1;
   uint64_t next_out_slot_ = 1;
+  uint64_t votes_batched_ = 0;  // loop thread only
+  uint64_t votes_dropped_ = 0;
 
   std::unordered_map<uint64_t, Listener> listeners_;  // loop thread only
   std::unordered_map<uint64_t, InConn> in_conns_;
@@ -1011,6 +1224,44 @@ void hs_net_send(void* ctx, const char* host, uint16_t port,
   static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
 }
 
+// Install (or clear, with n_authors=0) the vote pre-stage on a listener:
+// ``authors`` is n_authors*32 bytes of committee public keys. Frames that
+// match the fixed Vote wire layout are then length-validated, decoded,
+// seat-checked, round-gated and deduped on the loop thread, and admitted
+// votes reach Python as ONE EV_VOTE_BATCH per poll cycle.
+void hs_net_set_vote_filter(void* ctx, uint64_t listener_id,
+                            const uint8_t* authors, uint32_t n_authors) {
+  Command c;
+  c.type = CMD_SET_VOTE_FILTER;
+  c.id = listener_id;
+  if (authors != nullptr && n_authors > 0) {
+    c.payload.assign(reinterpret_cast<const char*>(authors),
+                     size_t(n_authors) * 32);
+  }
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+// Advance the pre-stage's stale-round cutoff (monotonic; lower values
+// are ignored). Also GCs dedupe state for rounds below the cutoff.
+void hs_net_set_round(void* ctx, uint64_t listener_id, uint64_t round) {
+  Command c;
+  c.type = CMD_SET_ROUND;
+  c.id = listener_id;
+  c.count = round;
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+// Best-effort broadcast: one command, one frame build, N peer queues.
+// ``addrs``/``addrs_len``: space-separated "ip:port" tokens.
+void hs_net_broadcast(void* ctx, const char* addrs, uint32_t addrs_len,
+                      const uint8_t* data, uint32_t len) {
+  Command c;
+  c.type = CMD_BROADCAST;
+  c.host.assign(addrs, addrs_len);
+  c.payload.assign(reinterpret_cast<const char*>(data), len);
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
 void hs_net_close_listener(void* ctx, uint64_t listener_id) {
   Command c;
   c.type = CMD_CLOSE_LISTENER;
@@ -1045,8 +1296,9 @@ int64_t hs_net_drain(void* ctx, uint8_t* buf, uint32_t cap) {
   return static_cast<NetCore*>(ctx)->drain(buf, cap);
 }
 
-// out[5] = {pending, inflight, cancelled, out_conns, in_conns}. Blocks
-// until the loop thread services the request (microseconds when live).
+// out[7] = {pending, inflight, cancelled, out_conns, in_conns,
+// votes_batched, votes_dropped}. Blocks until the loop thread services
+// the request (microseconds when live).
 void hs_net_stats(void* ctx, uint64_t* out) {
   StatsReq req;
   Command c;
@@ -1055,7 +1307,7 @@ void hs_net_stats(void* ctx, uint64_t* out) {
   if (!static_cast<NetCore*>(ctx)->push_cmd(std::move(c))) {
     // Loop thread already exited: report zeros instead of blocking on a
     // request nothing will ever service.
-    for (int i = 0; i < 5; i++) out[i] = 0;
+    for (int i = 0; i < 7; i++) out[i] = 0;
     return;
   }
   std::unique_lock<std::mutex> lk(req.mu);
@@ -1065,6 +1317,8 @@ void hs_net_stats(void* ctx, uint64_t* out) {
   out[2] = req.cancelled;
   out[3] = req.out_conns;
   out[4] = req.in_conns;
+  out[5] = req.votes_batched;
+  out[6] = req.votes_dropped;
 }
 
 }  // extern "C"

@@ -12,15 +12,25 @@ import asyncio
 import logging
 import random
 
+import struct
+
 from .budget import BUDGET
-from .receiver import read_frame, write_frame
+from .receiver import read_frame
 
 log = logging.getLogger("network")
 
 QUEUE_CAPACITY = 1_000
+_LEN = struct.Struct(">I")
+# Frames gathered into one write/drain round trip when the queue has a
+# backlog (the asyncio analog of the native engine's writev batching).
+_WRITE_BATCH = 64
 
 
 class _Connection:
+    """Holds PRE-FRAMED bytes: the length prefix is attached once by the
+    sender (once per BROADCAST, not once per peer), and the write loop
+    gathers every immediately-available frame into a single write+drain."""
+
     def __init__(self, address: tuple[str, int]) -> None:
         self.address = address
         self.queue: asyncio.Queue[bytes] = asyncio.Queue(QUEUE_CAPACITY)
@@ -53,7 +63,13 @@ class _Connection:
                 while True:
                     data = await self.queue.get()
                     self._writing = True
-                    write_frame(writer, data)
+                    writer.write(data)
+                    # Gather the backlog: every already-queued frame rides
+                    # the same drain (one flow-control round trip).
+                    burst = 1
+                    while burst < _WRITE_BATCH and not self.queue.empty():
+                        writer.write(self.queue.get_nowait())
+                        burst += 1
                     await writer.drain()
                     self._writing = False
             except (ConnectionError, OSError) as e:
@@ -88,17 +104,25 @@ class SimpleSender:
         self._connections: dict[tuple[str, int], _Connection] = {}
         self._rng = random.Random()
 
-    def send(self, address: tuple[str, int], data: bytes) -> None:
-        """Fire-and-forget one frame to ``address``."""
+    def _send_framed(self, address: tuple[str, int], framed: bytes) -> None:
         conn = self._connections.get(address)
-        if conn is None or not conn.try_send(data):
+        if conn is None or not conn.try_send(framed):
             conn = _Connection(address)
             self._connections[address] = conn
-            conn.try_send(data)
+            conn.try_send(framed)
+
+    def send(self, address: tuple[str, int], data: bytes) -> None:
+        """Fire-and-forget one frame to ``address``."""
+        self._send_framed(address, _LEN.pack(len(data)) + data)
 
     def broadcast(self, addresses: list[tuple[str, int]], data: bytes) -> None:
+        # Shared encode: the wire frame is built ONCE and the same bytes
+        # object is queued to every peer (previously each peer's write
+        # loop re-attached the length prefix — one allocation+copy per
+        # peer per broadcast, N² per round at committee scale).
+        framed = _LEN.pack(len(data)) + data
         for addr in addresses:
-            self.send(addr, data)
+            self._send_framed(addr, framed)
 
     def lucky_broadcast(
         self, addresses: list[tuple[str, int]], data: bytes, nodes: int
@@ -106,8 +130,9 @@ class SimpleSender:
         """Send to ``nodes`` randomly-picked addresses (reference
         ``simple_sender.rs:76-85``) — the sync-retry gossip primitive."""
         picked = self._rng.sample(addresses, min(nodes, len(addresses)))
+        framed = _LEN.pack(len(data)) + data
         for addr in picked:
-            self.send(addr, data)
+            self._send_framed(addr, framed)
 
     def shutdown(self) -> None:
         for conn in self._connections.values():

@@ -20,7 +20,24 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 from jax.sharding import Mesh, PartitionSpec as P
-from jax import shard_map
+
+try:  # jax >= 0.5 exports shard_map at top level
+    from jax import shard_map as _shard_map_fn
+except ImportError:  # older jax: experimental namespace, module-per-name
+    from jax.experimental.shard_map import shard_map as _shard_map_fn
+
+import inspect as _inspect
+
+if "check_vma" in _inspect.signature(_shard_map_fn).parameters:
+    shard_map = _shard_map_fn
+else:
+    # Older jax spells the replication-check knob ``check_rep``.
+    def shard_map(f=None, /, **kwargs):
+        if "check_vma" in kwargs:
+            kwargs["check_rep"] = kwargs.pop("check_vma")
+        if f is None:
+            return lambda g: _shard_map_fn(g, **kwargs)
+        return _shard_map_fn(f, **kwargs)
 
 from hotstuff_tpu.ops import curve as cv
 from hotstuff_tpu.ops import field as fe

@@ -131,3 +131,32 @@ async def test_honest_nodes_commit_under_attack_with_batched_votes():
         BASE + 20,
         Parameters(timeout_delay=3_000, batch_vote_verification=True),
     )
+
+
+def test_honest_nodes_commit_under_attack_native_prestage(monkeypatch):
+    """Full-stack equivalence of the native vote pre-stage under active
+    byzantine attack: the consensus receivers run on the C++ transport
+    (votes length-validated, seat-filtered, deduped and batch-delivered
+    in C++; egress broadcasts coalesced), with the attack mix including
+    equivocating votes and garbage signatures — the exact inputs the
+    duplicate-vote ejection path arbitrates. Honest nodes must commit the
+    same chain they commit on the asyncio transport."""
+    from hotstuff_tpu.network import native as hsnative
+    import pytest as _pytest
+
+    if not hsnative.available():
+        _pytest.skip("native transport toolchain unavailable")
+
+    import hotstuff_tpu.consensus.consensus as consensus_mod
+    import hotstuff_tpu.consensus.core as core_mod
+
+    monkeypatch.setattr(consensus_mod, "Receiver", hsnative.NativeReceiver)
+    monkeypatch.setattr(core_mod, "SimpleSender", hsnative.NativeSimpleSender)
+
+    async def run():
+        await _run_byzantine_case(
+            BASE + 40,
+            Parameters(timeout_delay=3_000, batch_vote_verification=True),
+        )
+
+    asyncio.run(asyncio.wait_for(run(), timeout=60))

@@ -205,6 +205,13 @@ def test_cofactored_batch_semantics_unified():
 def test_slow_recheck_rate_limiter():
     """Crafted invalid signatures must not buy unbounded pure-Python work:
     after the token bucket drains, OpenSSL's rejection is final."""
+    import hotstuff_tpu.crypto as crypto_mod
+
+    if not crypto_mod._HAVE_PYCA:
+        pytest.skip(
+            "token bucket guards the OpenSSL-disagreement re-check path; "
+            "without the cryptography package that path cannot execute"
+        )
     backend = CpuBackend()
     backend.SLOW_CHECK_BUDGET = 2
     backend._slow_tokens = 2.0

@@ -300,9 +300,13 @@ async def test_native_throughput_many_frames():
 def test_resolve_negative_cache_has_ttl(monkeypatch):
     """A transient getaddrinfo failure must not blacklist a peer for the
     process lifetime (advisor finding r4): after the retry window the
-    next send re-resolves and succeeds."""
+    next lookup re-resolves and succeeds. Lookups run on the resolver
+    worker, so the backoff CAP can be short — a recovered name is usable
+    again within a minute."""
     import socket as socket_mod
     import time as time_mod
+
+    assert hsnative._RESOLVE_RETRY_MAX_S == 60.0  # advisor finding r5
 
     transport = hsnative.NativeTransport.__new__(hsnative.NativeTransport)
     transport._resolved = {}
@@ -319,13 +323,13 @@ def test_resolve_negative_cache_has_ttl(monkeypatch):
 
     monkeypatch.setattr(socket_mod, "getaddrinfo", flaky_getaddrinfo)
 
-    assert transport._resolve("node7.example") is None
-    # Within the retry window: cached negative, no new blocking lookup.
-    assert transport._resolve("node7.example") is None
+    assert transport._resolve_blocking("node7.example") is None
+    # Within the retry window: cached negative, no new lookup.
+    assert transport._resolve_blocking("node7.example") is None
     assert calls["n"] == 1
 
-    # Consecutive failures back off exponentially (a persistently-bad
-    # name must not stall the loop on a blocking lookup every period).
+    # Consecutive failures back off exponentially, so a persistently-bad
+    # name is not looked up on every send.
     _, next_backoff = transport._resolve_retry_at["node7.example"]
     assert next_backoff == 2 * hsnative._RESOLVE_RETRY_S
 
@@ -334,9 +338,31 @@ def test_resolve_negative_cache_has_ttl(monkeypatch):
         time_mod, "monotonic",
         lambda base=time_mod.monotonic(): base + hsnative._RESOLVE_RETRY_S + 1,
     )
-    assert transport._resolve("node7.example") == "10.0.0.7"
+    assert transport._resolve_blocking("node7.example") == "10.0.0.7"
     assert calls["n"] == 2
     # Positive result cached; failure backoff state reset.
-    assert transport._resolve("node7.example") == "10.0.0.7"
+    assert transport._resolve_blocking("node7.example") == "10.0.0.7"
     assert calls["n"] == 2
     assert "node7.example" not in transport._resolve_retry_at
+
+
+def test_resolver_worker_flushes_parked_sends():
+    """A send to a not-yet-resolved hostname must not block the event
+    loop on getaddrinfo: it parks behind the worker lookup and is
+    flushed once the name resolves."""
+    import asyncio as _asyncio
+
+    async def run():
+        port = BASE_PORT + 30
+        task = _asyncio.create_task(listener(port, expected=b"parked"))
+        await _asyncio.sleep(0.05)
+        transport = hsnative.NativeTransport.get()
+        # "localhost" may already be cached from other tests: use an alias
+        # that only the real resolver knows, monkeypatch-free.
+        transport._resolved.pop("localhost", None)
+        sender = hsnative.NativeSimpleSender()
+        sender.send(("localhost", port), b"parked")
+        assert await _asyncio.wait_for(task, 10) == b"parked"
+        sender.shutdown()
+
+    _asyncio.run(run())

@@ -113,6 +113,76 @@ def test_requests_pool_behind_inflight_call_and_fuse():
     assert backend.fused_requests == 6 and backend.inner_calls == 2
 
 
+def test_identical_requests_dedup_inside_fused_flush():
+    """Byte-identical (msg, pub, sig) triples fused into one flush are
+    verified ONCE: the N copies of a rebroadcast QC (or of a proposal's
+    author signature fanned to N in-process validators) collapse to one
+    — verifying the distinct set decides the multiset. Verdicts stay
+    per-request."""
+    inner = GatedBackend()
+    backend = BatchingBackend(inner)
+    same = make_request(tag=b"same-qc")
+    opener = threading.Thread(
+        target=backend.verify_batch, args=make_request(tag=b"opener")
+    )
+    opener.start()
+    assert inner.first_entered.wait(10)
+    threads = [
+        threading.Thread(target=backend.verify_batch, args=same)
+        for _ in range(5)
+    ]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        with backend._lock:
+            if len(backend._pending) == 5:
+                break
+        threading.Event().wait(0.01)
+    inner.release_first.set()
+    opener.join(10)
+    for t in threads:
+        t.join(10)
+    # Five identical 3-sig requests fused into ONE 3-sig inner call.
+    assert inner.calls == [3, 3], inner.calls
+    assert backend.deduped_sigs == 12
+
+
+def test_identical_bad_requests_still_reject_each_caller():
+    """Dedup must not launder rejections: every caller of an identical
+    INVALID triple gets its own CryptoError (per-request fallback)."""
+    inner = GatedBackend()
+    backend = BatchingBackend(inner)
+    msgs, pubs, sigs = make_request(tag=b"bad")
+    sigs = [b"\x07" * 64 for _ in sigs]  # garbage signatures
+    bad = (msgs, pubs, sigs)
+    opener = threading.Thread(
+        target=backend.verify_batch, args=make_request(tag=b"opener2")
+    )
+    opener.start()
+    assert inner.first_entered.wait(10)
+    errors = [None, None, None]
+
+    def worker(i):
+        try:
+            backend.verify_batch(*bad)
+        except CryptoError as e:
+            errors[i] = e
+
+    threads = [threading.Thread(target=worker, args=(i,)) for i in range(3)]
+    for t in threads:
+        t.start()
+    for _ in range(100):
+        with backend._lock:
+            if len(backend._pending) == 3:
+                break
+        threading.Event().wait(0.01)
+    inner.release_first.set()
+    opener.join(10)
+    for t in threads:
+        t.join(10)
+    assert all(isinstance(e, CryptoError) for e in errors)
+
+
 def test_lone_request_flushes_immediately():
     """An idle device means zero added latency: a lone QC goes straight
     through (round 2 charged it a fixed 2 ms collection window)."""
