@@ -41,11 +41,26 @@ async def run_committee(
     rounds_target: int,
     base_port: int,
     timeout_delay: int,
-    profile: dict | None = None,
+    profile: bool = False,
+    telemetry_path: str | None = None,
 ):
+    """Returns ``(seconds_per_round, stage_profile | None)`` where the
+    stage profile — measured-window deltas of the registry's
+    ``consensus.stage.<kind>.{ns,calls}`` counters — covers EVERY
+    engine's core (the whole committee's per-round handler cost)."""
+    from hotstuff_tpu import telemetry
     from hotstuff_tpu.consensus import Authority, Committee, Consensus, Parameters
     from hotstuff_tpu.crypto import SignatureService, generate_keypair
     from hotstuff_tpu.store import Store
+
+    emitter = None
+    if telemetry_path:
+        emitter = telemetry.TelemetryEmitter(
+            telemetry.get_registry(),
+            telemetry_path,
+            node=f"committee-{n}",
+            interval_s=telemetry.env_interval_s(),
+        ).spawn()
 
     keys = [generate_keypair() for _ in range(n)]
     committee = Committee(
@@ -86,24 +101,37 @@ async def run_committee(
 
     # Wait for the first commit everywhere, then time rounds_target more.
     await asyncio.gather(*[q.get() for q in commits])
-    warmup = (
-        {k: list(v) for k, v in profile.items()} if profile is not None else None
-    )
+    registry = telemetry.get_registry()
+    warmup = registry.snapshot()["counters"] if profile else None
     t0 = time.perf_counter()
     for _ in range(rounds_target):
         await asyncio.gather(*[q.get() for q in commits])
     elapsed = time.perf_counter() - t0
-    if profile is not None:
-        # Reduce to the measured window only (warm-up handlers excluded).
-        for kind, (ns, calls) in list(profile.items()):
-            base_ns, base_calls = warmup.get(kind, (0, 0))
-            profile[kind] = [ns - base_ns, calls - base_calls]
 
+    stage_profile: dict[str, tuple[int, int]] | None = None
+    if profile:
+        # Measured-window deltas only (warm-up handlers excluded).
+        deltas = telemetry.diff_counters(warmup, registry.snapshot()["counters"])
+        stage_profile = {}
+        prefix = "consensus.stage."
+        for name, value in deltas.items():
+            if not name.startswith(prefix):
+                continue
+            kind, field = name[len(prefix):].rsplit(".", 1)
+            ns, calls = stage_profile.get(kind, (0, 0))
+            if field == "ns":
+                ns += value
+            elif field == "calls":
+                calls += value
+            stage_profile[kind] = (ns, calls)
+
+    if emitter is not None:
+        await emitter.shutdown()
     for e in engines:
         await e.shutdown()
     for s in sinks:
         s.cancel()
-    return elapsed / rounds_target
+    return elapsed / rounds_target, stage_profile
 
 
 def run_crypto_rounds(n: int, rounds: int, tc_heavy: bool) -> float:
@@ -169,10 +197,25 @@ def main() -> None:
         action="store_true",
         help="protocol mode: print per-stage µs/round (aggregated over "
         "every engine's core — the whole committee's per-round handler "
-        "cost on this core)",
+        "cost on this core; sourced from the telemetry registry's "
+        "consensus.stage.* counters)",
+    )
+    p.add_argument(
+        "--telemetry",
+        metavar="PATH",
+        help="protocol mode: enable the telemetry plane and stream "
+        "JSON-lines snapshots to PATH (final snapshot at shutdown; "
+        "interval via HOTSTUFF_TELEMETRY_INTERVAL)",
     )
     p.add_argument("--output", help="directory to append the result file to")
     args = p.parse_args()
+
+    if args.telemetry:
+        # BEFORE actors/backends are constructed: they capture their
+        # metric objects at creation time.
+        from hotstuff_tpu import telemetry as _telemetry
+
+        _telemetry.enable()
 
     if args.mode == "protocol":
         # The one-process committee multiplexes N engines' verification
@@ -187,12 +230,13 @@ def main() -> None:
 
     backend = get_backend().name
     f = (args.nodes - 1) // 3
-    profile: dict | None = {} if (args.profile and args.mode == "protocol") else None
+    stage_profile = None
     if args.mode == "protocol":
-        per_round = asyncio.run(
+        per_round, stage_profile = asyncio.run(
             run_committee(
                 args.nodes, args.rounds, args.base_port, args.timeout,
-                profile=profile,
+                profile=args.profile,
+                telemetry_path=args.telemetry,
             )
         )
     else:
@@ -212,19 +256,26 @@ def main() -> None:
         f"{per_round * 1e3:.1f} ms/round ({1 / per_round:.2f} rounds/s)"
     )
     print(line)
-    if profile:
+    profile_lines = []
+    if stage_profile:
         # Aggregated over ALL engines: the committee's whole per-round
-        # handler bill on this core, by stage.
-        print(f"per-stage handler cost (all {args.nodes} engines, "
-              f"{args.rounds} measured rounds):")
-        print(f"  {'stage':<10} {'calls/round':>12} {'us/round':>12}")
+        # handler bill on this core, by stage (telemetry registry,
+        # consensus.stage.* counters over the measured window).
+        profile_lines.append(
+            f"per-stage handler cost (all {args.nodes} engines, "
+            f"{args.rounds} measured rounds, telemetry registry):"
+        )
+        profile_lines.append(
+            f"  {'stage':<10} {'calls/round':>12} {'us/round':>12}"
+        )
         for kind, (ns, calls) in sorted(
-            profile.items(), key=lambda kv: -kv[1][0]
+            stage_profile.items(), key=lambda kv: -kv[1][0]
         ):
-            print(
+            profile_lines.append(
                 f"  {kind:<10} {calls / args.rounds:>12.1f} "
                 f"{ns / 1e3 / args.rounds:>12.1f}"
             )
+        print("\n".join(profile_lines))
     if args.output:
         os.makedirs(args.output, exist_ok=True)
         tag = f"{args.mode}{'-tc' if args.tc_heavy else ''}"
@@ -233,6 +284,8 @@ def main() -> None:
         )
         with open(path, "a") as out:
             out.write(line + "\n")
+            for pl in profile_lines:
+                out.write(pl + "\n")
 
 
 if __name__ == "__main__":

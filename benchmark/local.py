@@ -52,6 +52,7 @@ class LocalBench:
         max_batch_delay: int = 10,
         work_dir: str = ".bench",
         crypto_backend: str = "cpu",
+        telemetry: bool = False,
     ) -> None:
         self.nodes = nodes
         self.rate = rate
@@ -64,6 +65,7 @@ class LocalBench:
         self.max_batch_delay = max_batch_delay
         self.work_dir = os.path.abspath(work_dir)
         self.crypto_backend = crypto_backend
+        self.telemetry = telemetry
         self._procs: list[subprocess.Popen] = []
 
     def _cleanup(self) -> None:
@@ -132,6 +134,12 @@ class LocalBench:
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         env["HOTSTUFF_CRYPTO_BACKEND"] = self.crypto_backend
+        if self.telemetry:
+            # Nodes stream telemetry-<name>.jsonl next to their logs. A
+            # short interval keeps the stream's tail close to the SIGKILL
+            # teardown (nodes never get to write a final snapshot here).
+            env["HOTSTUFF_TELEMETRY_DIR"] = logs_dir
+            env.setdefault("HOTSTUFF_TELEMETRY_INTERVAL", "1")
 
         booted = self.nodes - self.faults  # faults = don't boot the last f
         try:

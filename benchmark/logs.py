@@ -12,15 +12,29 @@ harness (our nodes emit the identical line formats):
 Multi-node timestamps are merged keeping the earliest (``logs.py:64-71``);
 the parser doubles as the correctness oracle: tracebacks/errors in any log
 raise ParseError (``logs.py:74-75,91-92``).
+
+``TelemetryParser`` is the regex path's structured sibling: it reads the
+JSON-lines snapshot streams nodes emit when telemetry is enabled
+(``HOTSTUFF_TELEMETRY_DIR``, see ``hotstuff_tpu/telemetry``) and computes
+the consensus TPS/latency measurements from the registry's counters and
+histograms instead of scraping log lines. The telemetry recorders run at
+the exact code sites that emit the regex-scraped lines, so both paths
+measure the same events; small deltas remain (telemetry credits a batch
+at its proposer's/creator's local observations when nodes run in
+separate processes, while the regex path merges earliest-across-nodes) —
+see docs/telemetry.md.
 """
 
 from __future__ import annotations
 
 import glob
+import json
 import os
 from datetime import datetime
 from re import findall, search
 from statistics import mean
+
+from hotstuff_tpu.telemetry import validate_snapshot
 
 
 class ParseError(Exception):
@@ -215,3 +229,122 @@ class LogParser:
             with open(fn) as f:
                 nodes.append(f.read())
         return cls(clients, nodes, faults)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry-stream reader (the structured path).
+# ---------------------------------------------------------------------------
+
+
+def read_telemetry_stream(path: str) -> list[dict]:
+    """Parse one JSON-lines snapshot file; skips blank lines, raises
+    ParseError on malformed JSON or schema-invalid snapshots."""
+    snapshots = []
+    with open(path) as f:
+        for lineno, line in enumerate(f, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                obj = json.loads(line)
+            except json.JSONDecodeError as e:
+                raise ParseError(f"{path}:{lineno}: bad JSON: {e}") from e
+            problems = validate_snapshot(obj)
+            if problems:
+                raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
+            snapshots.append(obj)
+    return snapshots
+
+
+class TelemetryParser:
+    """Consensus TPS/latency from telemetry snapshot streams.
+
+    ``streams`` is one list of parsed snapshots per source (file / node);
+    only each stream's LAST snapshot matters (counters are cumulative).
+    Cross-stream merge mirrors the regex parser's: the measurement window
+    is [min first-proposal, max last-commit] across streams, committed
+    bytes sum (each batch is credited exactly once, by its creator), and
+    latency histograms merge by bucket addition.
+    """
+
+    def __init__(self, streams: list[list[dict]], tx_size: int | None = None):
+        finals = [s[-1] for s in streams if s]
+        if not finals:
+            raise ParseError("no telemetry snapshots")
+        self.snapshots = finals
+        self.tx_size = tx_size
+
+        def gauge(snap, name):
+            return snap["gauges"].get(name)
+
+        starts = [
+            g
+            for s in finals
+            if (g := gauge(s, "consensus.first_proposal_ts")) is not None
+        ]
+        ends = [
+            g
+            for s in finals
+            if (g := gauge(s, "consensus.last_commit_ts")) is not None
+        ]
+        self.start = min(starts) if starts else None
+        self.end = max(ends) if ends else None
+        self.committed_bytes = sum(
+            s["counters"].get("consensus.committed_bytes", 0) for s in finals
+        )
+        self.committed_batches = sum(
+            s["counters"].get("consensus.batches_committed", 0) for s in finals
+        )
+        self.latency_sum_ms = 0.0
+        self.latency_count = 0
+        for s in finals:
+            h = s["histograms"].get("consensus.commit_latency_ms")
+            if h is not None:
+                self.latency_sum_ms += h["sum"]
+                self.latency_count += h["count"]
+
+    def counter_total(self, name: str) -> int:
+        return sum(s["counters"].get(name, 0) for s in self.snapshots)
+
+    def consensus_throughput(self) -> tuple[float, float, float]:
+        """(tps, bps, duration_s); tps is 0 unless ``tx_size`` was given."""
+        if self.start is None or self.end is None or self.end <= self.start:
+            return 0.0, 0.0, 0.0
+        duration = self.end - self.start
+        bps = self.committed_bytes / duration
+        tps = bps / self.tx_size if self.tx_size else 0.0
+        return tps, bps, duration
+
+    def consensus_latency_ms(self) -> float:
+        if not self.latency_count:
+            return 0.0
+        return self.latency_sum_ms / self.latency_count
+
+    def result(self) -> str:
+        tps, bps, duration = self.consensus_throughput()
+        return (
+            "\n"
+            "-----------------------------------------\n"
+            " TELEMETRY SUMMARY:\n"
+            "-----------------------------------------\n"
+            f" Snapshot streams: {len(self.snapshots)}\n"
+            f" Measured window: {duration:.1f} s\n"
+            f" Committed batches: {self.committed_batches:,}\n"
+            "\n"
+            f" Consensus TPS: {round(tps):,} tx/s\n"
+            f" Consensus BPS: {round(bps):,} B/s\n"
+            f" Consensus latency: {round(self.consensus_latency_ms()):,} ms\n"
+            "-----------------------------------------\n"
+        )
+
+    @classmethod
+    def process(cls, directory: str, tx_size: int | None = None) -> "TelemetryParser":
+        streams = [
+            read_telemetry_stream(fn)
+            for fn in sorted(
+                glob.glob(os.path.join(directory, "telemetry-*.jsonl"))
+            )
+        ]
+        if not streams:
+            raise ParseError(f"no telemetry-*.jsonl streams in {directory}")
+        return cls(streams, tx_size=tx_size)

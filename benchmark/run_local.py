@@ -30,6 +30,12 @@ def main() -> None:
         default="cpu",
         choices=["cpu", "tpu", "cpu-batched", "tpu-batched"],
     )
+    p.add_argument(
+        "--telemetry",
+        action="store_true",
+        help="nodes stream JSON-lines telemetry snapshots next to their "
+        "logs; prints the telemetry-derived SUMMARY alongside the regex one",
+    )
     args = p.parse_args()
 
     bench = LocalBench(
@@ -44,9 +50,19 @@ def main() -> None:
         max_batch_delay=args.max_batch_delay,
         work_dir=args.work_dir,
         crypto_backend=args.crypto_backend,
+        telemetry=args.telemetry,
     )
     parser = bench.run()
     print(parser.result())
+    if args.telemetry:
+        from benchmark.logs import TelemetryParser
+
+        print(
+            TelemetryParser.process(
+                os.path.join(os.path.abspath(args.work_dir), "logs"),
+                tx_size=args.tx_size,
+            ).result()
+        )
 
 
 if __name__ == "__main__":

@@ -69,7 +69,7 @@ def main() -> None:
     signal.setitimer(
         signal.ITIMER_PROF, args.interval_ms / 1e3, args.interval_ms / 1e3
     )
-    per_round = asyncio.run(
+    per_round, _ = asyncio.run(
         run_committee(args.nodes, args.rounds, args.base_port, 30_000)
     )
     signal.setitimer(signal.ITIMER_PROF, 0)

@@ -85,7 +85,7 @@ class Consensus:
         tx_mempool: asyncio.Queue,  # Synchronize/Cleanup to mempool
         tx_commit: asyncio.Queue,  # committed blocks out
         benchmark: bool = False,
-        profile: dict | None = None,  # per-stage ns accumulator (bench)
+        profile: bool = False,  # per-stage ns counters -> telemetry registry
     ) -> "Consensus":
         self = cls()
         parameters.log()

@@ -25,6 +25,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from hotstuff_tpu import telemetry
 from hotstuff_tpu.crypto import PublicKey, SignatureService
 from hotstuff_tpu.network import SimpleSender
 from hotstuff_tpu.store import Store, StoreError
@@ -61,6 +62,15 @@ _STATE_KEY = b"__consensus_state__"
 
 
 class Core:
+    # Class-level no-op defaults: state-only instances (tests build Core
+    # via ``__new__`` to exercise single handlers) and fully-wired cores
+    # with telemetry disabled share the same do-nothing metric objects;
+    # ``__init__`` overrides them with live ones when telemetry is on.
+    _m_proposals = _m_votes = _m_timeouts_rx = _m_timeouts = telemetry.NULL_COUNTER
+    _m_qcs = _m_tcs = _m_rounds = _m_blocks = telemetry.NULL_COUNTER
+    _g_round = _g_committed_round = telemetry.NULL_GAUGE
+    _trace = None
+
     def __init__(
         self,
         name: PublicKey,
@@ -79,7 +89,7 @@ class Core:
         persist_sync: bool = False,
         batch_vote_verification: bool = False,
         on_round_advance=None,
-        profile: dict | None = None,
+        profile: bool = False,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -117,10 +127,27 @@ class Core:
         # C++ vote pre-stage so its stale-round cutoff tracks the core's.
         # None on the asyncio transport.
         self._on_round_advance = on_round_advance
-        # Optional per-stage profiling (benchmark --profile): kind ->
-        # [total_ns, calls]. One perf_counter_ns pair per event when on;
-        # zero branches beyond a None check when off.
-        self._profile = profile
+        # Optional per-stage profiling (benchmark --profile): one
+        # perf_counter_ns pair per handled event, accumulated into the
+        # telemetry registry as ``consensus.stage.<kind>.{ns,calls}``
+        # counters (benchmarks diff registry snapshots around their
+        # measured window). One truthiness check per event when off.
+        self._profile = bool(profile)
+        # Telemetry plane. The metric objects are no-op singletons when
+        # telemetry is disabled, so each record below costs one cheap
+        # method call; the round tracer is None when disabled (its marks
+        # take timestamps, which we skip entirely).
+        self._m_proposals = telemetry.counter("consensus.proposals_received")
+        self._m_votes = telemetry.counter("consensus.votes_received")
+        self._m_timeouts_rx = telemetry.counter("consensus.timeouts_received")
+        self._m_timeouts = telemetry.counter("consensus.timeouts_fired")
+        self._m_qcs = telemetry.counter("consensus.qcs_formed")
+        self._m_tcs = telemetry.counter("consensus.tcs_formed")
+        self._m_rounds = telemetry.counter("consensus.rounds_advanced")
+        self._m_blocks = telemetry.counter("consensus.blocks_committed")
+        self._g_round = telemetry.gauge("consensus.round")
+        self._g_committed_round = telemetry.gauge("consensus.last_committed_round")
+        self._trace = telemetry.round_trace()
         # This node's verified-certificate memory: rebroadcast QCs/TCs
         # (every view-change timeout carries the same high_qc; every
         # TC-former broadcasts the TC; timers retransmit) verify once
@@ -215,8 +242,16 @@ class Core:
         self.last_committed_round = block.round
 
         for blk in reversed(to_commit):
+            self._m_blocks.inc()
+            self._g_committed_round.set(blk.round)
+            if self._trace is not None:
+                self._trace.mark_commit(blk.round)
             if blk.payload:
                 log.info("Committed %s", blk)
+                for d in blk.payload:
+                    # Telemetry mirror of the "Committed B -> d" contract
+                    # (no-op unless telemetry is enabled).
+                    telemetry.record_commit(d.data)
                 if self.benchmark:
                     for d in blk.payload:
                         # NOTE: benchmark measurement interface (reference
@@ -234,6 +269,7 @@ class Core:
 
     async def local_timeout_round(self) -> None:
         log.warning("Timeout reached for round %d", self.round)
+        self._m_timeouts.inc()
         self.increase_last_voted_round(self.round)
         await self._persist_state()
         timeout = await Timeout.new(
@@ -274,8 +310,11 @@ class Core:
 
     async def handle_vote(self, vote: Vote) -> None:
         log.debug("Processing %r", vote)
+        self._m_votes.inc()
         if vote.round < self.round:
             return
+        if self._trace is not None:
+            self._trace.mark_vote(vote.round)
         if vote.round > self.round + self.MAX_ROUND_LOOKAHEAD:
             log.warning("dropping vote %d rounds ahead", vote.round - self.round)
             return
@@ -289,6 +328,9 @@ class Core:
 
     async def _complete_qc(self, qc: QC) -> None:
         log.debug("Assembled %r", qc)
+        self._m_qcs.inc()
+        if self._trace is not None:
+            self._trace.mark_qc(qc.round)
         await self.process_qc(qc)
         if self.name == self.leader_elector.get_leader(self.round):
             await self.generate_proposal(None)
@@ -451,6 +493,7 @@ class Core:
 
     async def handle_timeout(self, timeout: Timeout) -> None:
         log.debug("Processing %r", timeout)
+        self._m_timeouts_rx.inc()
         if timeout.round < self.round:
             return
         if timeout.round > self.round + self.MAX_ROUND_LOOKAHEAD:
@@ -488,6 +531,7 @@ class Core:
         tc = self.aggregator.add_timeout(timeout)
         if tc is not None:
             log.debug("Assembled %r", tc)
+            self._m_tcs.inc()
             await self.advance_round(tc.round)
             addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
             self.network.broadcast(addresses, encode_tc(tc))
@@ -499,6 +543,8 @@ class Core:
             return
         self.timer.reset()
         self.round = round_ + 1
+        self._m_rounds.inc()
+        self._g_round.set(self.round)
         if self._on_round_advance is not None:
             self._on_round_advance(self.round)
         log.debug("Moved to round %d", self.round)
@@ -521,6 +567,11 @@ class Core:
 
     async def process_block(self, block: Block) -> None:
         log.debug("Processing %r", block)
+        if self._trace is not None:
+            # Loopback (our own proposal) reaches here without
+            # handle_proposal; first mark wins, so the double call on the
+            # network path is harmless.
+            self._trace.mark_propose(block.round)
         # We need the two ancestors b0 <- |qc0; b1| <- |qc1; block|; if any is
         # missing the synchronizer fetches them and re-injects this block.
         ancestors = await self.synchronizer.get_ancestors(block)
@@ -567,6 +618,9 @@ class Core:
 
     async def handle_proposal(self, block: Block) -> None:
         digest = block.digest()
+        self._m_proposals.inc()
+        if self._trace is not None:
+            self._trace.mark_propose(block.round)
         # Redelivery short-circuit: helpers answer sync requests with
         # ancestor CHAINS, so bursts can re-include blocks already fully
         # processed (stored => verified, certificates applied, ancestry
@@ -695,8 +749,16 @@ class Core:
             # Seed the pre-stage cutoff with the (possibly restored) round.
             self._on_round_advance(self.round)
         profile = self._profile
-        if profile is not None:
+        if profile:
             import time as _time
+
+            # Stage counters live in the process telemetry registry:
+            # ``consensus.stage.<kind>.{ns,calls}`` — an in-process
+            # committee's engines all add into the same counters, giving
+            # the whole committee's per-round handler bill in one place
+            # (benchmarks diff registry snapshots around their window).
+            registry = telemetry.get_registry()
+            stage_counters: dict[str, tuple] = {}
         try:
             while True:
                 kind, payload = await self.rx_message.get()
@@ -711,14 +773,19 @@ class Core:
                 handler = handlers.get(kind)
                 if handler is None:
                     log.error("unexpected protocol message kind %s", kind)
-                elif profile is None:
+                elif not profile:
                     await self._guarded(handler(payload))
                 else:
+                    pair = stage_counters.get(kind)
+                    if pair is None:
+                        pair = stage_counters[kind] = (
+                            registry.counter(f"consensus.stage.{kind}.ns"),
+                            registry.counter(f"consensus.stage.{kind}.calls"),
+                        )
                     t0 = _time.perf_counter_ns()
                     await self._guarded(handler(payload))
-                    slot = profile.setdefault(kind, [0, 0])
-                    slot[0] += _time.perf_counter_ns() - t0
-                    slot[1] += 1
+                    pair[0].inc(_time.perf_counter_ns() - t0)
+                    pair[1].inc()
         finally:
             timer_task.cancel()
 

@@ -12,6 +12,7 @@ import asyncio
 import logging
 from dataclasses import dataclass
 
+from hotstuff_tpu import telemetry
 from hotstuff_tpu.crypto import Digest, PublicKey, SignatureService
 from hotstuff_tpu.network import ReliableSender
 
@@ -86,6 +87,10 @@ class Proposer:
         )
         if block.payload:
             log.info("Created %s", block)
+            for d in block.payload:
+                # Telemetry mirror of the "Created B -> d" measurement
+                # contract (no-op unless telemetry is enabled).
+                telemetry.record_created(d.data)
             if self.benchmark:
                 for d in block.payload:
                     # NOTE: benchmark measurement interface (reference

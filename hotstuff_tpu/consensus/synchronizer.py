@@ -14,6 +14,7 @@ import asyncio
 import logging
 import time
 
+from hotstuff_tpu import telemetry
 from hotstuff_tpu.crypto import Digest, PublicKey
 from hotstuff_tpu.network import SimpleSender
 from hotstuff_tpu.store import Store
@@ -72,6 +73,7 @@ class Synchronizer:
         task.add_done_callback(self._tasks.discard)
         if parent not in self._requests:
             log.debug("requesting sync for block %s", parent)
+            telemetry.counter("consensus.sync_requests").inc()
             self._requests[parent] = time.monotonic()
             address = self.committee.address(block.author)
             if address is not None:

@@ -500,6 +500,10 @@ class CpuBackend:
     def verify_batch(self, msgs, pubs, sigs) -> None:
         if not len(msgs) == len(pubs) == len(sigs):
             raise CryptoError("batch length mismatch")
+        from hotstuff_tpu import telemetry
+
+        telemetry.counter("crypto.dispatch.cpu").inc()
+        telemetry.counter("crypto.dispatch.cpu_sigs").inc(len(msgs))
         # Without OpenSSL, even a batch of one routes to the native RLC
         # engine — the pure-Python serial loop below is milliseconds per
         # signature and only ever acceptable as the last-resort fallback.

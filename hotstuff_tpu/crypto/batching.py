@@ -24,6 +24,9 @@ below the bridge).
 from __future__ import annotations
 
 import threading
+import time
+
+from hotstuff_tpu import telemetry
 
 from . import BackendUnavailable, CryptoError, get_backend, set_backend
 
@@ -59,10 +62,19 @@ class BatchingBackend:
         self._thread: threading.Thread | None = None
         # Observability: how many inner calls vs requests, and how many
         # signatures the identical-triple dedup removed (exposed for
-        # tests and diagnostics).
+        # tests and diagnostics; mirrored into the telemetry registry).
         self.fused_requests = 0
         self.inner_calls = 0
         self.deduped_sigs = 0
+        self._m_requests = telemetry.counter("crypto.superbatch.requests")
+        self._m_flushes = telemetry.counter("crypto.superbatch.flushes")
+        self._m_deduped = telemetry.counter("crypto.superbatch.deduped_sigs")
+        self._h_occupancy = telemetry.histogram(
+            "crypto.superbatch.occupancy", telemetry.COUNT_BUCKETS
+        )
+        self._h_flush_ms = telemetry.histogram(
+            "crypto.superbatch.flush_ms", telemetry.DURATION_MS_BUCKETS
+        )
 
     def verify_batch(self, msgs, pubs, sigs) -> None:
         if not len(msgs) == len(pubs) == len(sigs):
@@ -106,6 +118,10 @@ class BatchingBackend:
 
     def _flush(self, batch: list[_Request]) -> None:
         self.fused_requests += len(batch)
+        self._m_requests.inc(len(batch))
+        self._m_flushes.inc()
+        self._h_occupancy.observe(len(batch))
+        t0 = time.perf_counter()
         fused_ok = False
         try:
             # Dedup identical (msg, pub, sig) triples across the fused
@@ -132,7 +148,9 @@ class BatchingBackend:
                     msgs.append(m)
                     pubs.append(p)
                     sigs.append(s)
-            self.deduped_sigs += sum(len(r.msgs) for r in batch) - len(msgs)
+            removed = sum(len(r.msgs) for r in batch) - len(msgs)
+            self.deduped_sigs += removed
+            self._m_deduped.inc(removed)
             try:
                 self.inner_calls += 1
                 if len(msgs) <= self.max_sigs:
@@ -171,6 +189,7 @@ class BatchingBackend:
                             "verification flush aborted"
                         )
                     r.done.set()
+            self._h_flush_ms.observe((time.perf_counter() - t0) * 1e3)
 
 
 def enable_superbatching(

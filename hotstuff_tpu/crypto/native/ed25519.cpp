@@ -14,10 +14,20 @@
 // Single-threaded by design: the box this serves is one core, and the
 // caller (crypto backend) already parallelizes across batches if needed.
 
+#include <atomic>
 #include <cstdint>
 #include <cstring>
 #include <mutex>
 #include <vector>
+
+// Engine-level telemetry counters, exported via hs_ed25519_stats.
+// Relaxed atomics: callers run concurrently on the crypto worker pool
+// and the superbatch flusher; exact cross-thread ordering is
+// irrelevant for monotonic totals.
+static std::atomic<uint64_t> g_msm_calls{0};       // batch-verify MSM evaluations
+static std::atomic<uint64_t> g_msm_points{0};      // MSM lanes (points) processed
+static std::atomic<uint64_t> g_scalarmult_calls{0};  // sign/derive basepoint mults
+static std::atomic<uint64_t> g_decompress_calls{0};  // single-point decompressions
 
 typedef unsigned __int128 u128;
 
@@ -392,6 +402,8 @@ static inline int scalar_window(const uint8_t* scalar, int bit, int c) {
 int hs_ed25519_msm_is_identity(const uint8_t* encodings,
                                const uint8_t* scalars, uint64_t m, int c) {
     if (encodings == nullptr || scalars == nullptr || m == 0) return -1;
+    g_msm_calls.fetch_add(1, std::memory_order_relaxed);
+    g_msm_points.fetch_add(m, std::memory_order_relaxed);
     if (c < 1) c = 1;
     if (c > 12) c = 12;
 
@@ -474,6 +486,8 @@ int hs_ed25519_msm_signed(const uint8_t* encodings, const uint8_t* pre_xy,
                           const uint8_t* flags, const uint8_t* scalars,
                           uint64_t m, int c, int cofactored) {
     if (encodings == nullptr || scalars == nullptr || m == 0) return -1;
+    g_msm_calls.fetch_add(1, std::memory_order_relaxed);
+    g_msm_points.fetch_add(m, std::memory_order_relaxed);
     if (c < 1) c = 1;
     if (c > 12) c = 12;
 
@@ -571,6 +585,7 @@ int hs_ed25519_msm_signed(const uint8_t* encodings, const uint8_t* pre_xy,
 // and mod-L scalar arithmetic, exactly like the batch-verify split).
 int hs_ed25519_scalarmult_base(const uint8_t* scalar, uint8_t* out32) {
     if (scalar == nullptr || out32 == nullptr) return -1;
+    g_scalarmult_calls.fetch_add(1, std::memory_order_relaxed);
     std::call_once(g_base_table_once, build_base_table);
     pt acc = PT_IDENTITY;
     bool started = false;
@@ -594,6 +609,7 @@ int hs_ed25519_scalarmult_base(const uint8_t* scalar, uint8_t* out32) {
 // field bytes when out is non-null.
 int hs_ed25519_decompress_check(const uint8_t* enc, uint8_t* out64) {
     if (enc == nullptr) return -1;
+    g_decompress_calls.fetch_add(1, std::memory_order_relaxed);
     pt p;
     if (!pt_decompress(p, enc)) return 0;
     if (out64 != nullptr) {
@@ -601,6 +617,23 @@ int hs_ed25519_decompress_check(const uint8_t* enc, uint8_t* out64) {
         fe_tobytes(out64 + 32, p.y);
     }
     return 1;
+}
+
+// Telemetry snapshot: fills up to ``cap`` slots in the order
+// {msm_calls, msm_points, scalarmult_calls, decompress_calls} and
+// returns the number filled. One call exports every engine counter —
+// the registry collector reads this once per snapshot.
+int hs_ed25519_stats(uint64_t* out, int cap) {
+    if (out == nullptr || cap <= 0) return 0;
+    const uint64_t fields[4] = {
+        g_msm_calls.load(std::memory_order_relaxed),
+        g_msm_points.load(std::memory_order_relaxed),
+        g_scalarmult_calls.load(std::memory_order_relaxed),
+        g_decompress_calls.load(std::memory_order_relaxed),
+    };
+    int n = cap < 4 ? cap : 4;
+    for (int i = 0; i < n; i++) out[i] = fields[i];
+    return n;
 }
 
 }  // extern "C"

@@ -83,8 +83,32 @@ def _load():
             ctypes.c_char_p,
             ctypes.c_char_p,
         ]
+        lib.hs_ed25519_stats.restype = ctypes.c_int
+        lib.hs_ed25519_stats.argtypes = [
+            ctypes.POINTER(ctypes.c_uint64),
+            ctypes.c_int,
+        ]
         _lib = lib
+        # The engine's counters surface through the registry's single
+        # snapshot call once the library is live.
+        from hotstuff_tpu import telemetry
+
+        telemetry.register_collector("crypto.native", native_stats)
     return _lib
+
+
+# hs_ed25519_stats field order (new fields append; indices never move).
+ED25519_STATS_FIELDS = (
+    "msm_calls", "msm_points", "scalarmult_calls", "decompress_calls"
+)
+
+
+def native_stats() -> dict[str, int]:
+    """Engine counter snapshot: verify-side MSM evaluations/lanes plus
+    sign/derive basepoint multiplications — one call exports them all."""
+    out = (ctypes.c_uint64 * len(ED25519_STATS_FIELDS))()
+    n = _load().hs_ed25519_stats(out, len(ED25519_STATS_FIELDS))
+    return {name: out[i] for i, name in enumerate(ED25519_STATS_FIELDS[:n])}
 
 
 def native_available(build: bool = True) -> bool:

@@ -66,6 +66,10 @@ class TpuBackend:
             raise CryptoError("batch length mismatch")
         if not msgs:
             return
+        from hotstuff_tpu import telemetry
+
+        telemetry.counter("crypto.dispatch.tpu").inc()
+        telemetry.counter("crypto.dispatch.tpu_sigs").inc(len(msgs))
         try:
             if self._mesh is not None and self._cache is not None:
                 try:

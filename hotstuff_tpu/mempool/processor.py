@@ -23,6 +23,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from hotstuff_tpu import telemetry
 from hotstuff_tpu.crypto import Digest, sha512_digest
 from hotstuff_tpu.store import Store
 
@@ -49,6 +50,8 @@ class Processor:
         device_digests: bool = False,
     ) -> asyncio.Task:
         async def run():
+            m_batches = telemetry.counter("mempool.batches_processed")
+            m_bytes = telemetry.counter("mempool.batch_bytes_stored")
             while True:
                 batch: bytes = await rx_batch.get()
                 batches = [batch]
@@ -74,6 +77,8 @@ class Processor:
                 else:
                     digests = [sha512_digest(b) for b in batches]
                 for digest, b in zip(digests, batches):
+                    m_batches.inc()
+                    m_bytes.inc(len(b))
                     await store.write(digest.data, b)
                     await tx_digest.put(digest)
 

@@ -157,8 +157,26 @@ def _load():
         lib.hs_net_stats.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64)
         ]
+        lib.hs_net_stats_ex.restype = ctypes.c_int
+        lib.hs_net_stats_ex.argtypes = [
+            ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
+        ]
         _lib = lib
     return _lib
+
+
+# hs_net_stats_ex field order (new fields append; indices never move).
+STATS_FIELDS = (
+    "pending", "inflight", "cancelled", "out_conns", "in_conns",
+    "votes_batched", "votes_dropped", "votes_dropped_dup",
+    "frames_rx", "bytes_rx", "frames_tx", "bytes_tx",
+    "writev_calls", "send_drops",
+)
+
+# Rate limit for the loop-side drop warnings (satellite: silent filtering
+# must be diagnosable without a debugger, but a flood of drops must not
+# become a flood of log lines).
+_DROP_WARN_INTERVAL_S = 10.0
 
 
 class NativeTransport:
@@ -200,6 +218,16 @@ class NativeTransport:
         self._resolve_lock = threading.Lock()
         self._resolve_pool: ThreadPoolExecutor | None = None
         self._parked_sends: dict[str, list[tuple[int, bytes, bool, int]]] = {}
+        # Drop diagnosability: last counters the rate-limited warning saw,
+        # and the next time _on_events may poll stats for it.
+        self._drop_warn_seen = {"filtered": 0, "send_drops": 0}
+        self._drop_warn_at = 0.0
+        self._drop_poll_at = time.monotonic() + _DROP_WARN_INTERVAL_S
+        # Telemetry: the engine's counters surface as gauges behind the
+        # registry's one snapshot call (collector polls stats() lazily).
+        from hotstuff_tpu import telemetry
+
+        telemetry.register_collector("net.native", self.stats)
 
     @classmethod
     def get(cls) -> "NativeTransport":
@@ -373,18 +401,54 @@ class NativeTransport:
         )
 
     def stats(self) -> dict[str, int]:
-        """Loop-thread state snapshot (tests / operational visibility)."""
-        out = (ctypes.c_uint64 * 7)()
-        self._lib.hs_net_stats(self._ctx, out)
-        return {
-            "pending": out[0],
-            "inflight": out[1],
-            "cancelled": out[2],
-            "out_conns": out[3],
-            "in_conns": out[4],
-            "votes_batched": out[5],
-            "votes_dropped": out[6],
-        }
+        """Loop-thread state snapshot (tests / telemetry / ops). One call
+        exports every engine counter; also drives the rate-limited drop
+        warnings (any periodic reader — telemetry emitter, event pump —
+        keeps drop diagnosability alive)."""
+        out = (ctypes.c_uint64 * len(STATS_FIELDS))()
+        n = self._lib.hs_net_stats_ex(self._ctx, out, len(STATS_FIELDS))
+        result = {name: out[i] for i, name in enumerate(STATS_FIELDS[:n])}
+        self._warn_on_drops(result)
+        return result
+
+    def _warn_on_drops(self, stats: dict[str, int]) -> None:
+        """Log (rate-limited) when the vote pre-stage starts FILTERING
+        votes (seat/round rejections — dedup of identical resends is
+        routine and only reported alongside) or per-peer back-pressure
+        starts dropping best-effort sends. Without this, a misconfigured
+        committee table or saturated peer silently eats frames that only
+        a debugger attached to the C++ loop would reveal."""
+        filtered = stats.get("votes_dropped", 0) - stats.get(
+            "votes_dropped_dup", 0
+        )
+        send_drops = stats.get("send_drops", 0)
+        seen = self._drop_warn_seen
+        d_filtered = filtered - seen["filtered"]
+        d_sends = send_drops - seen["send_drops"]
+        if d_filtered <= 0 and d_sends <= 0:
+            return
+        now = time.monotonic()
+        if now - self._drop_warn_at < _DROP_WARN_INTERVAL_S:
+            return
+        self._drop_warn_at = now
+        seen["filtered"] = filtered
+        seen["send_drops"] = send_drops
+        if d_filtered > 0:
+            log.warning(
+                "native vote pre-stage filtered %d vote frame(s) since the "
+                "last report (unknown seat or out-of-window round; %d "
+                "identical-resend dedups total): check committee table / "
+                "round sync if unexpected",
+                d_filtered,
+                stats.get("votes_dropped_dup", 0),
+            )
+        if d_sends > 0:
+            log.warning(
+                "native transport dropped %d best-effort send(s) at "
+                "per-peer back-pressure caps since the last report "
+                "(slow or dead peer)",
+                d_sends,
+            )
 
     def send(
         self, address: tuple[str, int], data: bytes,
@@ -438,6 +502,13 @@ class NativeTransport:
             os.read(self._efd, 8)  # clear the signal
         except BlockingIOError:
             pass
+        # Periodic drop check even when nothing else reads stats(): one
+        # loop-thread round trip (microseconds) at most once per warning
+        # interval, piggybacked on event activity.
+        now = time.monotonic()
+        if now >= self._drop_poll_at:
+            self._drop_poll_at = now + _DROP_WARN_INTERVAL_S
+            self.stats()
         while True:
             n = self._lib.hs_net_drain(self._ctx, self._buf, len(self._buf))
             if n < 0:

@@ -137,6 +137,17 @@ struct StatsReq {
   uint64_t in_conns = 0;
   uint64_t votes_batched = 0;  // vote frames delivered via EV_VOTE_BATCH
   uint64_t votes_dropped = 0;  // vote frames dropped by the pre-stage
+  // Extended fields (hs_net_stats_ex; the legacy 7-slot hs_net_stats
+  // ignores them).
+  uint64_t votes_dropped_dup = 0;  // subset of votes_dropped: identical resends
+  uint64_t frames_rx = 0;   // inbound frames parsed (incl. pre-staged votes)
+  uint64_t bytes_rx = 0;    // inbound bytes read off sockets
+  uint64_t frames_tx = 0;   // outbound frames handed to the kernel
+  uint64_t bytes_tx = 0;    // outbound bytes accepted by the kernel
+  uint64_t writev_calls = 0;  // writev syscalls (frames_tx/writev_calls =
+                              // the egress coalescing factor)
+  uint64_t send_drops = 0;  // best-effort sends dropped at a peer's
+                            // SIMPLE_QUEUE_CAP back-pressure bound
 };
 
 struct Command {
@@ -613,6 +624,13 @@ class NetCore {
           s->in_conns = in_conns_.size();
           s->votes_batched = votes_batched_;
           s->votes_dropped = votes_dropped_;
+          s->votes_dropped_dup = votes_dropped_dup_;
+          s->frames_rx = frames_rx_;
+          s->bytes_rx = bytes_rx_;
+          s->frames_tx = frames_tx_;
+          s->bytes_tx = bytes_tx_;
+          s->writev_calls = writev_calls_;
+          s->send_drops = send_drops_;
           {
             // notify under the lock: after the unlock the waiter may
             // (spurious wakeup) observe done and destroy the
@@ -710,6 +728,7 @@ class NetCore {
         if (r > 0) {
           c.inbuf.append(buf, size_t(r));
           got += size_t(r);
+          bytes_rx_ += uint64_t(r);
         } else if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
           conn_gone = true;
           break;
@@ -735,6 +754,7 @@ class NetCore {
           return;
         }
         if (c.inbuf.size() - off - 4 < len) break;
+        frames_rx_++;
         bool charge = true;
         if (l != nullptr && l->vf_enabled && len == VOTE_WIRE_LEN &&
             uint8_t(c.inbuf[off + 4]) == VOTE_TAG) {
@@ -795,6 +815,7 @@ class NetCore {
     if (prev != seat_map.end() &&
         prev->second.compare(0, VOTE_WIRE_LEN, frame, VOTE_WIRE_LEN) == 0) {
       votes_dropped_++;  // identical resend of this seat's latest vote
+      votes_dropped_dup_++;
       return false;
     }
     seat_map[seat_it->second] = std::string(frame, VOTE_WIRE_LEN);
@@ -859,7 +880,10 @@ class NetCore {
   void send_simple(const std::string& host, uint16_t port,
                    const std::string& payload) {
     OutConn& c = out_conn(host, port, false);
-    if (c.pending.size() >= SIMPLE_QUEUE_CAP) return;  // best-effort drop
+    if (c.pending.size() >= SIMPLE_QUEUE_CAP) {  // best-effort drop
+      send_drops_++;
+      return;
+    }
     PendingMsg m;
     m.msg_id = 0;
     frame_append(m.frame, reinterpret_cast<const uint8_t*>(payload.data()),
@@ -894,6 +918,8 @@ class NetCore {
           c.pending.push_back(std::move(m));
           if (c.fd < 0 && !c.connecting) start_connect(c);
           if (c.fd >= 0 && !c.connecting) pump_out(c);
+        } else {
+          send_drops_++;
         }
       }
       pos = sp + 1;
@@ -1034,6 +1060,10 @@ class NetCore {
         conn_failed(c);
         return;
       }
+      if (w > 0) {
+        writev_calls_++;
+        bytes_tx_ += uint64_t(w);
+      }
       size_t remaining = size_t(w);
       if (!c.outbuf.empty()) {
         size_t take = std::min(remaining, c.outbuf.size());
@@ -1044,6 +1074,7 @@ class NetCore {
       for (; i < staged.size(); i++) {
         if (c.outbuf.empty() && remaining >= staged[i].frame.size()) {
           remaining -= staged[i].frame.size();
+          frames_tx_++;
           if (c.reliable) c.inflight.push_back(std::move(staged[i]));
           continue;
         }
@@ -1055,6 +1086,7 @@ class NetCore {
           // staging buffer; the frame itself is on the wire (inflight).
           c.outbuf.assign(staged[i].frame, remaining,
                           staged[i].frame.size() - remaining);
+          frames_tx_++;  // dispatched: its tail drains via outbuf
           if (c.reliable) c.inflight.push_back(std::move(staged[i]));
           i++;
         }
@@ -1174,6 +1206,13 @@ class NetCore {
   uint64_t next_out_slot_ = 1;
   uint64_t votes_batched_ = 0;  // loop thread only
   uint64_t votes_dropped_ = 0;
+  uint64_t votes_dropped_dup_ = 0;
+  uint64_t frames_rx_ = 0;
+  uint64_t bytes_rx_ = 0;
+  uint64_t frames_tx_ = 0;
+  uint64_t bytes_tx_ = 0;
+  uint64_t writev_calls_ = 0;
+  uint64_t send_drops_ = 0;
 
   std::unordered_map<uint64_t, Listener> listeners_;  // loop thread only
   std::unordered_map<uint64_t, InConn> in_conns_;
@@ -1319,6 +1358,37 @@ void hs_net_stats(void* ctx, uint64_t* out) {
   out[4] = req.in_conns;
   out[5] = req.votes_batched;
   out[6] = req.votes_dropped;
+}
+
+// Extended snapshot: fills up to ``cap`` slots in the order
+// {pending, inflight, cancelled, out_conns, in_conns, votes_batched,
+//  votes_dropped, votes_dropped_dup, frames_rx, bytes_rx, frames_tx,
+//  bytes_tx, writev_calls, send_drops} and returns the number filled
+// (new fields append, existing indices never move — callers probe the
+// return value instead of pinning a struct version). Same loop-thread
+// servicing as hs_net_stats.
+int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
+  if (out == nullptr || cap <= 0) return 0;
+  StatsReq req;
+  Command c;
+  c.type = CMD_STATS;
+  c.ptr = &req;
+  if (!static_cast<NetCore*>(ctx)->push_cmd(std::move(c))) {
+    for (int i = 0; i < cap; i++) out[i] = 0;
+    return cap < 14 ? cap : 14;
+  }
+  std::unique_lock<std::mutex> lk(req.mu);
+  req.cv.wait(lk, [&] { return req.done; });
+  const uint64_t fields[14] = {
+      req.pending,       req.inflight,     req.cancelled,
+      req.out_conns,     req.in_conns,     req.votes_batched,
+      req.votes_dropped, req.votes_dropped_dup, req.frames_rx,
+      req.bytes_rx,      req.frames_tx,    req.bytes_tx,
+      req.writev_calls,  req.send_drops,
+  };
+  int n = cap < 14 ? cap : 14;
+  for (int i = 0; i < n; i++) out[i] = fields[i];
+  return n;
 }
 
 }  // extern "C"

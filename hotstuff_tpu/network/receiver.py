@@ -7,6 +7,8 @@ import asyncio
 import logging
 import struct
 
+from hotstuff_tpu import telemetry
+
 log = logging.getLogger("network")
 
 _LEN = struct.Struct(">I")
@@ -113,9 +115,13 @@ class Receiver:
         framed = _AckedWriter() if self.auto_ack else FramedWriter(writer)
         self._writers.add(writer)
         self._conn_tasks.add(asyncio.current_task())
+        m_frames = telemetry.counter("net.frames_in")
+        m_bytes = telemetry.counter("net.bytes_in")
         try:
             while True:
                 frame = await read_frame(reader)
+                m_frames.inc()
+                m_bytes.inc(len(frame) + 4)
                 if self.auto_ack:
                     write_frame(writer, b"Ack")
                     # drain() keeps flow control: a peer that floods

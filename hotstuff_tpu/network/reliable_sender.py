@@ -30,6 +30,8 @@ import logging
 import random
 from collections import deque
 
+from hotstuff_tpu import telemetry
+
 from .budget import BUDGET
 from .receiver import read_frame, write_frame
 
@@ -149,6 +151,10 @@ class _Connection:
         # Sent but not yet ACKed on THIS connection; replayed on reconnect.
         inflight: deque[tuple[bytes, CancelHandler]] = deque()
 
+        m_frames = telemetry.counter("net.frames_out")
+        m_bytes = telemetry.counter("net.bytes_out")
+        m_writes = telemetry.counter("net.writes")
+
         async def write_loop() -> None:
             while True:
                 while self.pending:
@@ -157,6 +163,9 @@ class _Connection:
                         continue
                     inflight.append((data, handler))
                     write_frame(writer, data)
+                    m_frames.inc()
+                    m_bytes.inc(len(data) + 4)
+                    m_writes.inc()
                     await writer.drain()
                 self.new_work.clear()
                 await self.new_work.wait()

@@ -14,6 +14,8 @@ import random
 
 import struct
 
+from hotstuff_tpu import telemetry
+
 from .budget import BUDGET
 from .receiver import read_frame
 
@@ -36,6 +38,10 @@ class _Connection:
         self.queue: asyncio.Queue[bytes] = asyncio.Queue(QUEUE_CAPACITY)
         self.evicted = False
         self._writing = False
+        self._m_frames = telemetry.counter("net.frames_out")
+        self._m_bytes = telemetry.counter("net.bytes_out")
+        self._m_writes = telemetry.counter("net.writes")
+        self._m_drops = telemetry.counter("net.send_drops")
         self.task = asyncio.create_task(self._run())
         BUDGET.register(self)
 
@@ -64,12 +70,18 @@ class _Connection:
                     data = await self.queue.get()
                     self._writing = True
                     writer.write(data)
+                    nbytes = len(data)
                     # Gather the backlog: every already-queued frame rides
                     # the same drain (one flow-control round trip).
                     burst = 1
                     while burst < _WRITE_BATCH and not self.queue.empty():
-                        writer.write(self.queue.get_nowait())
+                        chunk = self.queue.get_nowait()
+                        writer.write(chunk)
+                        nbytes += len(chunk)
                         burst += 1
+                    self._m_frames.inc(burst)
+                    self._m_bytes.inc(nbytes)
+                    self._m_writes.inc()
                     await writer.drain()
                     self._writing = False
             except (ConnectionError, OSError) as e:
@@ -96,6 +108,7 @@ class _Connection:
             return True
         except asyncio.QueueFull:
             log.warning("dropping message to %s: channel full", self.address)
+            self._m_drops.inc()
             return True  # best-effort: dropped, but connection is alive
 
 

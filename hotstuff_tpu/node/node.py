@@ -8,6 +8,7 @@ from __future__ import annotations
 import asyncio
 import logging
 
+from hotstuff_tpu import telemetry
 from hotstuff_tpu.consensus import Consensus
 from hotstuff_tpu.crypto import SignatureService
 from hotstuff_tpu.mempool import Mempool
@@ -26,6 +27,7 @@ class Node:
         self.mempool: Mempool | None = None
         self.consensus: Consensus | None = None
         self.store: Store | None = None
+        self.telemetry_emitter: telemetry.TelemetryEmitter | None = None
 
     @classmethod
     async def new(
@@ -72,6 +74,18 @@ class Node:
             benchmark=benchmark,
         )
 
+        # Telemetry snapshot stream (HOTSTUFF_TELEMETRY[_DIR]): periodic
+        # JSON-lines snapshots plus a final one at shutdown —
+        # benchmark/logs.py reads these alongside the regex log scrape.
+        stream_path = telemetry.env_stream_path(str(secret.name))
+        if telemetry.enabled() and stream_path is not None:
+            self.telemetry_emitter = telemetry.TelemetryEmitter(
+                telemetry.get_registry(),
+                stream_path,
+                node=str(secret.name),
+                interval_s=telemetry.env_interval_s(),
+            ).spawn()
+
         log.info("Node %s successfully booted", secret.name)
         return self
 
@@ -86,5 +100,7 @@ class Node:
             await self.consensus.shutdown()
         if self.mempool is not None:
             await self.mempool.shutdown()
+        if self.telemetry_emitter is not None:
+            await self.telemetry_emitter.shutdown()
         if self.store is not None:
             self.store.close()

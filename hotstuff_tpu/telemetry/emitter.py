@@ -1,0 +1,138 @@
+"""JSON-lines snapshot emitter + snapshot schema validation.
+
+One snapshot is one line: a self-describing JSON object carrying the
+whole registry state (cumulative counters, current gauges, merged
+histograms). Cumulative-not-delta means a reader needs only the LAST
+line of a stream — a crashed node's stream is still fully usable up to
+its final interval, and intermediate lines give time series for free.
+
+``benchmark/logs.py`` consumes these streams (``TelemetryParser``)
+alongside its regex path; the CI smoke lane validates them with
+``validate_snapshot``.
+"""
+
+from __future__ import annotations
+
+import asyncio
+import json
+import logging
+import os
+import time
+
+log = logging.getLogger("telemetry")
+
+SCHEMA = "hotstuff-telemetry-v1"
+DEFAULT_INTERVAL_S = 5.0
+
+
+def build_snapshot(registry, node: str = "", seq: int = 0, final: bool = False) -> dict:
+    snap = registry.snapshot()
+    return {
+        "schema": SCHEMA,
+        "node": node,
+        "pid": os.getpid(),
+        "seq": seq,
+        "ts": time.time(),
+        "final": final,
+        "counters": snap["counters"],
+        "gauges": snap["gauges"],
+        "histograms": snap["histograms"],
+    }
+
+
+def validate_snapshot(obj) -> list[str]:
+    """Schema check for one parsed snapshot line; returns a list of
+    problems (empty == valid). Deliberately dependency-free — the CI
+    smoke lane and tests share it."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"snapshot is {type(obj).__name__}, not an object"]
+    if obj.get("schema") != SCHEMA:
+        problems.append(f"schema is {obj.get('schema')!r}, want {SCHEMA!r}")
+    for key, types in (
+        ("node", str), ("pid", int), ("seq", int),
+        ("ts", (int, float)), ("final", bool),
+        ("counters", dict), ("gauges", dict), ("histograms", dict),
+    ):
+        if not isinstance(obj.get(key), types):
+            problems.append(f"field {key!r} missing or mistyped")
+    for name, v in (obj.get("counters") or {}).items():
+        if not isinstance(v, int) or v < 0:
+            problems.append(f"counter {name!r} not a non-negative int")
+    for name, v in (obj.get("gauges") or {}).items():
+        if not isinstance(v, (int, float)):
+            problems.append(f"gauge {name!r} not a number")
+    for name, h in (obj.get("histograms") or {}).items():
+        if not isinstance(h, dict):
+            problems.append(f"histogram {name!r} not an object")
+            continue
+        le, counts = h.get("le"), h.get("counts")
+        if not isinstance(le, list) or not isinstance(counts, list):
+            problems.append(f"histogram {name!r} missing le/counts")
+            continue
+        if len(counts) != len(le) + 1:
+            problems.append(f"histogram {name!r}: {len(counts)} counts "
+                            f"for {len(le)} edges (want edges+1)")
+        if list(le) != sorted(le):
+            problems.append(f"histogram {name!r}: edges not sorted")
+        if not isinstance(h.get("count"), int) or not isinstance(
+            h.get("sum"), (int, float)
+        ):
+            problems.append(f"histogram {name!r} missing count/sum")
+        elif sum(counts) != h["count"]:
+            problems.append(
+                f"histogram {name!r}: bucket counts sum to {sum(counts)}, "
+                f"count says {h['count']}"
+            )
+    return problems
+
+
+class TelemetryEmitter:
+    """Appends one snapshot line to ``path`` every ``interval_s`` and a
+    ``final`` one at shutdown. Each write is a single buffered
+    write+flush of a complete line, so concurrent emitters appending to
+    the same file (in-process testbeds) interleave at line granularity."""
+
+    def __init__(
+        self,
+        registry,
+        path: str,
+        node: str = "",
+        interval_s: float = DEFAULT_INTERVAL_S,
+    ) -> None:
+        self.registry = registry
+        self.path = path
+        self.node = node
+        self.interval_s = max(float(interval_s), 0.05)
+        self._seq = 0
+        self._task: asyncio.Task | None = None
+        parent = os.path.dirname(os.path.abspath(path))
+        os.makedirs(parent, exist_ok=True)
+
+    def emit(self, final: bool = False) -> dict:
+        snapshot = build_snapshot(
+            self.registry, node=self.node, seq=self._seq, final=final
+        )
+        self._seq += 1
+        line = json.dumps(snapshot, separators=(",", ":"))
+        try:
+            with open(self.path, "a") as f:
+                f.write(line + "\n")
+        except OSError as e:  # telemetry must never kill the node
+            log.error("cannot write telemetry snapshot to %s: %s", self.path, e)
+        return snapshot
+
+    async def _run(self) -> None:
+        while True:
+            await asyncio.sleep(self.interval_s)
+            self.emit()
+
+    def spawn(self) -> "TelemetryEmitter":
+        self._task = asyncio.create_task(self._run(), name="telemetry_emitter")
+        return self
+
+    async def shutdown(self) -> None:
+        if self._task is not None:
+            self._task.cancel()
+            self._task = None
+        self.emit(final=True)

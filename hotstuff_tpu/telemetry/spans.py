@@ -1,0 +1,90 @@
+"""Round-trace spans: follow a block through propose -> vote fan-in ->
+QC formation -> commit and record per-stage durations.
+
+One ``RoundTrace`` lives in each consensus core (created only when
+telemetry is enabled; the core holds ``None`` otherwise, so the disabled
+hot path pays a single ``is not None`` check per event). Marks are
+keyed by round; a commit closes every round up to it (the 2-chain rule
+commits round r while the core works on r+2), so the table stays bounded
+even without commits via the ``max_rounds`` FIFO cap.
+
+Stage semantics (all durations in milliseconds, monotonic clock):
+
+- ``propose -> first_vote``: proposal seen/created to the first vote for
+  that round arriving. Only the round's vote collector (the NEXT leader)
+  receives votes, so only it observes this and the following span.
+- ``first_vote -> qc``: vote fan-in window — first vote to the assembled
+  QC passing verification.
+- ``qc -> commit``: certificate to 2-chain commit of that round's block
+  (spans the two follow-on rounds by construction).
+- ``propose -> commit``: the whole round trace end to end.
+"""
+
+from __future__ import annotations
+
+import time
+from collections import OrderedDict
+
+from .registry import DURATION_MS_BUCKETS, Registry
+
+_PROPOSE, _VOTE, _QC = 0, 1, 2
+
+
+class RoundTrace:
+    __slots__ = ("_rounds", "_max_rounds", "_h_pv", "_h_vq", "_h_qc", "_h_pc")
+
+    def __init__(self, registry: Registry, max_rounds: int = 512) -> None:
+        # round -> [propose_ts, first_vote_ts, qc_ts] (None until marked)
+        self._rounds: OrderedDict[int, list[float | None]] = OrderedDict()
+        self._max_rounds = max_rounds
+        h = registry.histogram
+        self._h_pv = h("consensus.span.propose_to_first_vote_ms", DURATION_MS_BUCKETS)
+        self._h_vq = h("consensus.span.first_vote_to_qc_ms", DURATION_MS_BUCKETS)
+        self._h_qc = h("consensus.span.qc_to_commit_ms", DURATION_MS_BUCKETS)
+        self._h_pc = h("consensus.span.propose_to_commit_ms", DURATION_MS_BUCKETS)
+
+    def _marks(self, round_: int) -> list[float | None]:
+        marks = self._rounds.get(round_)
+        if marks is None:
+            if len(self._rounds) >= self._max_rounds:
+                self._rounds.popitem(last=False)
+            marks = self._rounds[round_] = [None, None, None]
+        return marks
+
+    def mark_propose(self, round_: int) -> None:
+        marks = self._marks(round_)
+        if marks[_PROPOSE] is None:
+            marks[_PROPOSE] = time.perf_counter()
+
+    def mark_vote(self, round_: int) -> None:
+        marks = self._marks(round_)
+        if marks[_VOTE] is None:
+            marks[_VOTE] = time.perf_counter()
+
+    def mark_qc(self, round_: int) -> None:
+        marks = self._marks(round_)
+        if marks[_QC] is None:
+            marks[_QC] = time.perf_counter()
+            if marks[_VOTE] is not None:
+                self._h_vq.observe((marks[_QC] - marks[_VOTE]) * 1e3)
+            if marks[_PROPOSE] is not None and marks[_VOTE] is not None:
+                self._h_pv.observe((marks[_VOTE] - marks[_PROPOSE]) * 1e3)
+
+    def mark_commit(self, round_: int) -> None:
+        """Close round ``round_`` (and GC every older round: commits are
+        monotone, so anything below the committed round is finished)."""
+        now = time.perf_counter()
+        marks = self._rounds.get(round_)
+        if marks is not None:
+            if marks[_QC] is not None:
+                self._h_qc.observe((now - marks[_QC]) * 1e3)
+            if marks[_PROPOSE] is not None:
+                self._h_pc.observe((now - marks[_PROPOSE]) * 1e3)
+        while self._rounds:
+            oldest = next(iter(self._rounds))
+            if oldest > round_:
+                break
+            del self._rounds[oldest]
+
+    def open_rounds(self) -> int:
+        return len(self._rounds)
