@@ -184,6 +184,105 @@ def run_crypto_rounds(n: int, rounds: int, tc_heavy: bool) -> float:
     return (time.perf_counter() - t0) / rounds
 
 
+def run_faults(args) -> None:
+    """``--faults``: run a faultline scenario end-to-end on the
+    in-process committee and gate on the checker verdict. The scenario is
+    a JSON file or the ``chaos:<seed>`` shorthand; with ``--replay`` the
+    scenario runs TWICE and the two compiled fault schedules must be
+    byte-identical (the seed-reproducibility contract)."""
+    import json
+
+    from hotstuff_tpu import telemetry
+    from hotstuff_tpu.faultline import Scenario, chaos_scenario, run_scenario
+
+    telemetry.enable()  # faultline.* counters + RoundTrace annotations
+    if args.faults.startswith("chaos:"):
+        scenario = chaos_scenario(
+            int(args.faults.split(":", 1)[1]), duration_s=args.faults_duration
+        )
+    elif args.faults == "split":
+        # The view-change/recovery probe: cut the committee into two
+        # EVEN halves (neither holds 2f+1) for the middle 30% of the
+        # run. All progress stops, both sides burn timeout rounds; on
+        # heal the committee must timeout-sync, re-elect, and resume —
+        # the verdict's liveness.recovery_s IS the measured view-change
+        # + recovery cost.
+        d = args.faults_duration
+        half = args.nodes // 2
+        scenario = Scenario(
+            name="split",
+            seed=0,
+            duration_s=d,
+            events=[
+                {
+                    "kind": "partition",
+                    "groups": [
+                        list(range(half)), list(range(half, args.nodes))
+                    ],
+                    "at": round(0.3 * d, 3),
+                    "until": round(0.6 * d, 3),
+                }
+            ],
+        )
+    else:
+        scenario = Scenario.load(args.faults)
+
+    async def one_run(base_port: int) -> dict:
+        return await run_scenario(
+            scenario,
+            args.nodes,
+            base_port=base_port,
+            timeout_delay=args.timeout,
+            leader_elector=args.leader_elector,
+            # Committee-size-aware recovery bound: post-heal the whole
+            # committee must timeout-sync and re-quorum; at N=100 that
+            # is minutes of real work on one core, not the N=4 seconds.
+            recovery_timeout_s=max(30.0, 1.2 * args.nodes),
+        )
+
+    result = asyncio.run(one_run(args.base_port))
+    traces = [result["trace"]]
+    if args.replay:
+        replay = asyncio.run(one_run(args.base_port + args.nodes + 16))
+        traces.append(replay["trace"])
+        assert traces[0] == traces[1], "replay trace diverged for equal seeds"
+        result["replay_verdict"] = replay["verdict"]
+    verdict = result["verdict"]
+    fault_counters = {
+        k: v
+        for k, v in result["telemetry"]["counters"].items()
+        if k.startswith("faultline.")
+    }
+    report = {
+        "verdict": verdict,
+        # None (not true) when --replay didn't run: absence of evidence.
+        "replay_trace_match": (
+            traces[0] == traces[1] if len(traces) == 2 else None
+        ),
+        "trace": json.loads(traces[0]),
+        "faultline_counters": fault_counters,
+    }
+    ok = verdict["safety"]["ok"] and verdict["liveness"]["recovered"]
+    print(
+        f"faultline scenario={scenario.name} seed={scenario.seed} "
+        f"nodes={args.nodes}: safety={'ok' if verdict['safety']['ok'] else 'VIOLATED'} "
+        f"liveness={'recovered' if verdict['liveness']['recovered'] else 'STALLED'} "
+        f"commits={verdict['commits']} "
+        f"injections={verdict['injections']['counts']}"
+    )
+    if args.output:
+        os.makedirs(args.output, exist_ok=True)
+        path = os.path.join(
+            args.output, f"chaos-{scenario.name}-{args.nodes}.json"
+        )
+        with open(path, "w") as out:
+            json.dump(report, out, indent=2, sort_keys=True)
+            out.write("\n")
+        print(f"verdict written to {path}")
+    if not ok:
+        sys.exit(1)
+
+
 def main() -> None:
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=20)
@@ -192,6 +291,31 @@ def main() -> None:
     p.add_argument("--timeout", type=int, default=30_000)
     p.add_argument("--mode", choices=["protocol", "crypto"], default="protocol")
     p.add_argument("--tc-heavy", action="store_true")
+    p.add_argument(
+        "--faults",
+        metavar="SCENARIO",
+        help="run a faultline scenario (a JSON file, chaos:<seed> for a "
+        "seeded storm, or 'split' for the even two-way partition "
+        "view-change probe) on the in-process committee and exit nonzero "
+        "unless the checker reports safety=ok and liveness=recovered",
+    )
+    p.add_argument(
+        "--faults-duration",
+        type=float,
+        default=15.0,
+        help="chaos:<seed> scenario duration in virtual seconds",
+    )
+    p.add_argument(
+        "--replay",
+        action="store_true",
+        help="with --faults: run the scenario twice and assert the two "
+        "compiled fault schedules (replay traces) are identical",
+    )
+    p.add_argument(
+        "--leader-elector",
+        default="",
+        help="with --faults: consensus leader elector (e.g. reputation)",
+    )
     p.add_argument(
         "--profile",
         action="store_true",
@@ -209,6 +333,15 @@ def main() -> None:
     )
     p.add_argument("--output", help="directory to append the result file to")
     args = p.parse_args()
+
+    if args.faults:
+        # Chaos mode replaces the timing benchmark: a default --timeout
+        # of 30 s would let a single dead-leader round eat the whole
+        # scenario, so chaos runs use a snappier view-change budget.
+        if args.timeout == 30_000:
+            args.timeout = 1_000
+        run_faults(args)
+        return
 
     if args.telemetry:
         # BEFORE actors/backends are constructed: they capture their

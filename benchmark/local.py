@@ -53,6 +53,7 @@ class LocalBench:
         work_dir: str = ".bench",
         crypto_backend: str = "cpu",
         telemetry: bool = False,
+        chaos: str | None = None,
     ) -> None:
         self.nodes = nodes
         self.rate = rate
@@ -66,13 +67,24 @@ class LocalBench:
         self.work_dir = os.path.abspath(work_dir)
         self.crypto_backend = crypto_backend
         self.telemetry = telemetry
+        # Chaos mode: path to a faultline scenario JSON. Partition/link/
+        # byzantine events run INSIDE each node process (the env-armed
+        # FaultPlane); crash/restart events are enacted HERE by killing
+        # and relaunching real node processes. After the run the
+        # faultline checker judges the logs; the verdict lands in
+        # ``self.chaos_verdict``.
+        self.chaos = chaos
+        self.chaos_verdict: dict | None = None
         self._procs: list[subprocess.Popen] = []
+        self._node_procs: dict[int, subprocess.Popen] = {}
+        self._node_cmds: dict[int, tuple[list, str]] = {}  # i -> (cmd, log)
 
     def _cleanup(self) -> None:
-        for p in self._procs:
+        for p in [*self._procs, *self._node_procs.values()]:
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
         self._procs.clear()
+        self._node_procs.clear()
 
     @staticmethod
     def _wait_for_ports(addresses, timeout: float) -> None:
@@ -134,6 +146,17 @@ class LocalBench:
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO_ROOT + os.pathsep + env.get("PYTHONPATH", "")
         env["HOTSTUFF_CRYPTO_BACKEND"] = self.crypto_backend
+        schedule = None
+        if self.chaos:
+            from hotstuff_tpu.faultline import Scenario
+
+            scenario = Scenario.load(self.chaos)
+            schedule = scenario.compile([f"n{i:03d}" for i in range(n)])
+            # Arm every node process's in-process fault plane; telemetry
+            # rides along so the faultline.* counters exist in the
+            # emitted snapshots.
+            env["HOTSTUFF_FAULTLINE"] = os.path.abspath(self.chaos)
+            self.telemetry = True
         if self.telemetry:
             # Nodes stream telemetry-<name>.jsonl next to their logs. A
             # short interval keeps the stream's tail close to the SIGKILL
@@ -173,30 +196,30 @@ class LocalBench:
                     )
                 )
             for i in range(booted):
-                log_file = open(os.path.join(logs_dir, f"node-{i}.log"), "w")
-                self._procs.append(
-                    subprocess.Popen(
-                        [
-                            sys.executable,
-                            "-m",
-                            "hotstuff_tpu.node",
-                            # default verbosity is INFO; -v adds DEBUG, which
-                            # would skew the measured window.
-                            *(["-v"] if debug else []),
-                            "run",
-                            "--keys",
-                            key_files[i],
-                            "--committee",
-                            committee_file,
-                            "--store",
-                            os.path.join(self.work_dir, f"db_{i}"),
-                            "--parameters",
-                            params_file,
-                        ],
-                        stderr=log_file,
-                        env=env,
-                        cwd=REPO_ROOT,
-                    )
+                log_path = os.path.join(logs_dir, f"node-{i}.log")
+                cmd = [
+                    sys.executable,
+                    "-m",
+                    "hotstuff_tpu.node",
+                    # default verbosity is INFO; -v adds DEBUG, which
+                    # would skew the measured window.
+                    *(["-v"] if debug else []),
+                    "run",
+                    "--keys",
+                    key_files[i],
+                    "--committee",
+                    committee_file,
+                    "--store",
+                    os.path.join(self.work_dir, f"db_{i}"),
+                    "--parameters",
+                    params_file,
+                ]
+                self._node_cmds[i] = (cmd, log_path)
+                self._node_procs[i] = subprocess.Popen(
+                    cmd,
+                    stderr=open(log_path, "a"),
+                    env=env,
+                    cwd=REPO_ROOT,
                 )
 
             # Python interpreter startup is expensive (~2s CPU each on this
@@ -207,8 +230,111 @@ class LocalBench:
                 timeout=30 * booted,
             )
             time.sleep(2 * self.timeout_delay / 1000)
-            time.sleep(self.duration)
+            if schedule is None:
+                time.sleep(self.duration)
+            else:
+                heal_counts = self._supervise_chaos(schedule, env)
         finally:
             self._cleanup()
 
-        return LogParser.process(logs_dir, faults=self.faults)
+        parser = LogParser.process(logs_dir, faults=self.faults)
+        if schedule is not None:
+            self.chaos_verdict = self._judge_chaos(
+                logs_dir, schedule, heal_counts
+            )
+        return parser
+
+    # -- chaos supervision ---------------------------------------------------
+
+    def _restart_node(self, i: int, env: dict) -> None:
+        cmd, log_path = self._node_cmds[i]
+        self._node_procs[i] = subprocess.Popen(
+            cmd, stderr=open(log_path, "a"), env=env, cwd=REPO_ROOT
+        )
+
+    @staticmethod
+    def _commit_lines(logs_dir: str, i: int) -> list[tuple[int, str]]:
+        import re
+
+        path = os.path.join(logs_dir, f"node-{i}.log")
+        try:
+            with open(path) as f:
+                text = f.read()
+        except OSError:
+            return []
+        return [
+            (int(r), d)
+            for r, d in re.findall(r"FaultlineCommit r=(\d+) d=([0-9a-f]+)", text)
+        ]
+
+    def _supervise_chaos(self, schedule, env: dict) -> dict[int, int]:
+        """Enact the schedule's crash/restart events against real node
+        processes (partition/link/byzantine run inside the nodes via the
+        env-armed planes) and snapshot per-node commit-line counts at the
+        last heal — the liveness baseline ``_judge_chaos`` compares
+        against. Virtual t=0 is the moment the committee finished
+        booting, matching each node plane's process-boot anchor to within
+        interpreter-startup skew."""
+        logs_dir = os.path.join(self.work_dir, "logs")
+        actions = sorted(
+            (
+                (e.at, e.kind, e.params["node"])
+                for e in schedule.events
+                if e.kind in ("crash", "restart")
+            ),
+        )
+        heal_t = schedule.last_heal_time()
+        heal_counts: dict[int, int] = {}
+        t0 = time.monotonic()
+        while True:
+            elapsed = time.monotonic() - t0
+            while actions and actions[0][0] <= elapsed:
+                _, kind, node = actions.pop(0)
+                i = int(node.lstrip("n"))
+                proc = self._node_procs.get(i)
+                if kind == "crash":
+                    if proc is not None and proc.poll() is None:
+                        proc.send_signal(signal.SIGKILL)
+                        print(f"chaos: crashed node {i} at t={elapsed:.1f}s")
+                elif proc is None or proc.poll() is not None:
+                    self._restart_node(i, env)
+                    print(f"chaos: restarted node {i} at t={elapsed:.1f}s")
+            if not heal_counts and elapsed >= heal_t:
+                heal_counts = {
+                    i: len(self._commit_lines(logs_dir, i))
+                    for i in range(self.nodes - self.faults)
+                }
+            if elapsed >= self.duration:
+                # Recovery tail: a restarted node may still be walking a
+                # long sync catch-up when the measurement window closes.
+                # Give the committee a bounded extra window to prove
+                # post-heal commit growth before the SIGKILL teardown —
+                # the same grace the in-process harness grants.
+                recovered = heal_counts and all(
+                    len(self._commit_lines(logs_dir, i)) >= base + 3
+                    for i, base in heal_counts.items()
+                    if self._node_procs.get(i) is not None
+                    and self._node_procs[i].poll() is None
+                )
+                if recovered or elapsed >= self.duration + 45:
+                    break
+            time.sleep(0.2)
+        return heal_counts
+
+    def _judge_chaos(self, logs_dir: str, schedule, heal_counts) -> dict:
+        """Feed the scraped commit streams to the faultline checker.
+        Per-line virtual times aren't in the logs; what liveness needs is
+        only pre/post-heal attribution, which the heal-time count
+        snapshot gives exactly."""
+        from hotstuff_tpu.faultline import CommitRecord, check
+
+        heal_t = schedule.last_heal_time()
+        commits = {}
+        for i in range(self.nodes - self.faults):
+            lines = self._commit_lines(logs_dir, i)
+            cut = heal_counts.get(i, len(lines))
+            commits[f"n{i:03d}"] = [
+                CommitRecord(r, bytes.fromhex(d), 0.0 if k < cut else heal_t + 1.0)
+                for k, (r, d) in enumerate(lines)
+            ]
+        return check(schedule, commits)

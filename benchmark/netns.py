@@ -71,6 +71,38 @@ def setup(n: int) -> list[str]:
     return hosts
 
 
+def apply_netem(
+    hosts: list[str], rtt_ms: float, jitter_ms: float = 0.0,
+    loss_pct: float = 0.0,
+) -> bool:
+    """WAN shaping: attach ``tc netem`` to every namespace's egress.
+    Each side delays its own egress by rtt/2, so any A<->B round trip
+    pays the full RTT — the standard symmetric-WAN emulation. Loss is
+    per-direction. Returns False when the kernel lacks ``sch_netem``
+    (container kernels often do) — the caller then falls back to
+    faultline's app-layer link delay."""
+    if rtt_ms <= 0 and loss_pct <= 0:
+        return True
+    for ip in hosts:
+        ns = ns_name(ip)
+        cmd = ["ip", "netns", "exec", ns, "tc", "qdisc", "add", "dev",
+               "eth0", "root", "netem"]
+        if rtt_ms > 0:
+            cmd += ["delay", f"{rtt_ms / 2:.1f}ms"]
+            if jitter_ms > 0:
+                cmd += [f"{jitter_ms / 2:.1f}ms"]
+        if loss_pct > 0:
+            cmd += ["loss", f"{loss_pct}%"]
+        res = _run(cmd, check=False)
+        if res.returncode != 0:
+            print(
+                f"tc netem unavailable ({res.stderr.strip() or 'unknown'}); "
+                "falling back to faultline app-layer WAN shaping"
+            )
+            return False
+    return True
+
+
 def teardown() -> None:
     out = _run(["ip", "netns", "list"], check=False).stdout
     for line in out.splitlines():
@@ -148,6 +180,35 @@ def main() -> None:
     p.add_argument("--duration", type=int, default=20)
     p.add_argument("--faults", type=int, default=0)
     p.add_argument("--timeout", type=int, default=5_000)
+    p.add_argument(
+        "--rtt", type=float, default=0.0,
+        help="tc netem WAN shaping: full round-trip time in ms between "
+        "any two hosts (each namespace delays its egress by rtt/2)",
+    )
+    p.add_argument(
+        "--jitter", type=float, default=0.0,
+        help="tc netem delay jitter in ms (full-RTT scale, split per side)",
+    )
+    p.add_argument(
+        "--loss", type=float, default=0.0,
+        help="tc netem per-direction packet loss percentage",
+    )
+    p.add_argument(
+        "--partition", metavar="GROUPS",
+        help="partition mode: host-index groups separated by '|' (e.g. "
+        "'0,1|2,3'), cut at --partition-at and healed at "
+        "--partition-heal seconds into the run. Enacted by each node's "
+        "env-armed faultline plane (scheduled, deterministic, and "
+        "kernel-agnostic — unlike tc, which cannot time a cut)",
+    )
+    p.add_argument(
+        "--partition-at", type=float, default=5.0,
+        help="seconds into the run the partition cuts (with --partition)",
+    )
+    p.add_argument(
+        "--partition-heal", type=float, default=10.0,
+        help="seconds into the run the partition heals (with --partition)",
+    )
     p.add_argument("--output", help="directory to append the SUMMARY to")
     p.add_argument("--keep", action="store_true", help="skip teardown")
     args = p.parse_args()
@@ -169,6 +230,52 @@ def main() -> None:
     )
 
     hosts = setup(args.hosts)
+    netem_ok = apply_netem(hosts, args.rtt, args.jitter, args.loss)
+    events: list[dict] = []
+    if not netem_ok and (args.rtt > 0 or args.loss > 0):
+        # Kernel without sch_netem: emulate the WAN in the nodes
+        # themselves via a permanent faultline all-links rule (each
+        # side delays its egress by rtt/2; loss maps to per-frame drop).
+        events.append(
+            {
+                "kind": "link",
+                "src": "*",
+                "dst": "*",
+                "at": 0.0,
+                "delay_ms": [args.rtt / 2, args.rtt / 2 + args.jitter / 2],
+                "drop": args.loss / 100.0,
+            }
+        )
+    if args.partition:
+        # Partition mode: host-index groups (committee node names are
+        # positional — n000… in consensus-address order, which setup()
+        # makes identical to host order).
+        groups = [
+            [int(x) for x in group.split(",") if x != ""]
+            for group in args.partition.split("|")
+        ]
+        events.append(
+            {
+                "kind": "partition",
+                "groups": groups,
+                "at": args.partition_at,
+                "until": args.partition_heal,
+            }
+        )
+    node_env = ""
+    if events:
+        from hotstuff_tpu.faultline import Scenario
+
+        label = f"wan-rtt{int(args.rtt)}" if args.rtt else "partition"
+        chaos = Scenario(
+            name=f"netns-{label}",
+            seed=0,
+            duration_s=float(args.duration + 3600),
+            events=events,
+        )
+        wan_file = "/tmp/hs-netns-wan.json"
+        chaos.save(wan_file)
+        node_env = "HOTSTUFF_FAULTLINE=~/bench/chaos.json"
     try:
         from hotstuff_tpu.consensus import Parameters as CParams
         from hotstuff_tpu.mempool import Parameters as MParams
@@ -181,22 +288,40 @@ def main() -> None:
                 CParams(timeout_delay=args.timeout), MParams()
             )
         )
+        if node_env:
+            for host in hosts:
+                bench.runner.put(host, "/tmp/hs-netns-wan.json", "bench/chaos.json")
         parser = bench.run(
             rate=args.rate,
             tx_size=args.tx_size,
             duration=args.duration,
             faults=args.faults,
             timeout_delay=args.timeout,
+            node_env=node_env,
         )
         summary = parser.result()
         print(summary)
         if args.output:
             os.makedirs(args.output, exist_ok=True)
+            shaped = f"-rtt{int(args.rtt)}" if args.rtt else ""
+            if args.partition:
+                shaped += "-part"
             name = (
-                f"remote-netns-{args.faults}-{args.hosts}-"
+                f"remote-netns{shaped}-{args.faults}-{args.hosts}-"
                 f"{args.rate}-{args.tx_size}.txt"
             )
             with open(os.path.join(args.output, name), "a") as f:
+                if args.rtt or args.loss:
+                    f.write(
+                        f"netem: rtt={args.rtt}ms jitter={args.jitter}ms "
+                        f"loss={args.loss}%\n"
+                    )
+                if args.partition:
+                    f.write(
+                        f"partition: {args.partition} cut at "
+                        f"{args.partition_at}s healed at "
+                        f"{args.partition_heal}s\n"
+                    )
                 f.write(summary + "\n")
     finally:
         if not args.keep:

@@ -190,15 +190,20 @@ class RemoteBench:
         duration: int,
         faults: int = 0,
         timeout_delay: int = 5_000,
+        node_env: str = "",
     ) -> LogParser:
         """Boot clients then nodes, sleep for the duration, kill, download
-        and parse logs (reference ``remote.py:177-235``)."""
+        and parse logs (reference ``remote.py:177-235``). ``node_env`` is
+        a shell ``VAR=value ...`` prefix applied to the node processes
+        (e.g. ``HOTSTUFF_FAULTLINE=~/bench/chaos.json`` arms fault
+        injection on every host)."""
         self.kill()
         repo = self.settings.repo_name
         booted = self.hosts[: len(self.hosts) - faults]
         node_addrs = " ".join(
             f"{h}:{self.settings.front_port}" for h in booted
         )
+        env_prefix = f"{node_env} " if node_env else ""
         for host in booted:
             client = (
                 f"cd {repo} && nohup python3 -m hotstuff_tpu.node.client "
@@ -209,7 +214,7 @@ class RemoteBench:
             self._ssh(host, client)
         for host in booted:
             node = (
-                f"cd {repo} && nohup python3 -m hotstuff_tpu.node run "
+                f"cd {repo} && {env_prefix}nohup python3 -m hotstuff_tpu.node run "
                 f"--keys ~/bench/key.json --committee ~/bench/committee.json "
                 f"--store ~/bench/db --parameters ~/bench/parameters.json "
                 f"> /dev/null 2> ~/bench/node.log &"

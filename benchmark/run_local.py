@@ -36,7 +36,29 @@ def main() -> None:
         help="nodes stream JSON-lines telemetry snapshots next to their "
         "logs; prints the telemetry-derived SUMMARY alongside the regex one",
     )
+    p.add_argument(
+        "--chaos",
+        metavar="SCENARIO",
+        help="faultline scenario: a JSON file, or chaos:<seed> for a "
+        "seeded generated storm. Crash/restart events kill and relaunch "
+        "real node processes; partition/link/byzantine events run inside "
+        "each node via its env-armed fault plane. Prints the checker "
+        "verdict and exits nonzero on a safety violation or liveness "
+        "stall.",
+    )
     args = p.parse_args()
+
+    chaos_path = args.chaos
+    if chaos_path and chaos_path.startswith("chaos:"):
+        from hotstuff_tpu.faultline import chaos_scenario
+
+        scenario = chaos_scenario(
+            int(chaos_path.split(":", 1)[1]), duration_s=float(args.duration)
+        )
+        # NOT inside work_dir: LocalBench.run() wipes that tree before
+        # loading the scenario.
+        chaos_path = os.path.abspath(args.work_dir).rstrip("/") + "-scenario.json"
+        scenario.save(chaos_path)
 
     bench = LocalBench(
         nodes=args.nodes,
@@ -51,10 +73,11 @@ def main() -> None:
         work_dir=args.work_dir,
         crypto_backend=args.crypto_backend,
         telemetry=args.telemetry,
+        chaos=chaos_path,
     )
     parser = bench.run()
     print(parser.result())
-    if args.telemetry:
+    if args.telemetry or chaos_path:
         from benchmark.logs import TelemetryParser
 
         print(
@@ -63,6 +86,22 @@ def main() -> None:
                 tx_size=args.tx_size,
             ).result()
         )
+    if bench.chaos_verdict is not None:
+        import json
+
+        v = bench.chaos_verdict
+        print(
+            f"chaos verdict: safety="
+            f"{'ok' if v['safety']['ok'] else 'VIOLATED'} liveness="
+            f"{'recovered' if v['liveness']['recovered'] else 'STALLED'} "
+            f"commits={v['commits']}"
+        )
+        out = os.path.join(os.path.abspath(args.work_dir), "chaos-verdict.json")
+        with open(out, "w") as f:
+            json.dump(v, f, indent=2, sort_keys=True)
+        print(f"verdict written to {out}")
+        if not (v["safety"]["ok"] and v["liveness"]["recovered"]):
+            sys.exit(1)
 
 
 if __name__ == "__main__":

@@ -67,6 +67,11 @@ class Committee:
         # 2f+1 out of N=3f+1 by stake (reference ``config.rs:67-72``).
         return 2 * self.total_stake() // 3 + 1
 
+    def validity_threshold(self) -> Stake:
+        # f+1 by stake: any set this heavy contains at least one honest
+        # authority — the timeout-amplification trigger (Core.handle_timeout).
+        return (self.total_stake() - 1) // 3 + 1
+
     def address(self, name: PublicKey) -> tuple[str, int] | None:
         a = self.authorities.get(name)
         return a.address if a else None

@@ -27,6 +27,7 @@ import logging
 
 from hotstuff_tpu import telemetry
 from hotstuff_tpu.crypto import PublicKey, SignatureService
+from hotstuff_tpu.faultline import hooks as _faultline
 from hotstuff_tpu.network import SimpleSender
 from hotstuff_tpu.store import Store, StoreError
 from hotstuff_tpu.utils.serde import Decoder, Encoder, SerdeError
@@ -123,6 +124,9 @@ class Core:
         self._verified_seats: dict[Round, set] = {}
         # Strong references to in-flight qc_retry timer tasks.
         self._retry_tasks: set[asyncio.Task] = set()
+        # Rounds this node already amplified a timeout for (one own
+        # timeout per future round, however many peers retransmit).
+        self._amplified: set[Round] = set()
         # Native-transport hook: pushes each round advance down to the
         # C++ vote pre-stage so its stale-round cutoff tracks the core's.
         # None on the asyncio transport.
@@ -258,6 +262,16 @@ class Core:
                         # ``core.rs:145-149``).
                         log.info("Committed %s -> %s", blk, d)
             log.debug("Committed %r", blk)
+            if _faultline.plane is not None:
+                # Chaos-run audit line (INFO so it survives the default
+                # verbosity): the multi-process checker reconstructs each
+                # node's (round, digest) commit stream from these. One
+                # module-global load when faultline is off.
+                log.info(
+                    "FaultlineCommit r=%d d=%s",
+                    blk.round,
+                    blk.digest().data.hex(),
+                )
             # Committed blocks (in commit order) feed the elector's
             # participation window (no-op for round-robin).
             self.leader_elector.update(blk)
@@ -532,17 +546,63 @@ class Core:
         if tc is not None:
             log.debug("Assembled %r", tc)
             self._m_tcs.inc()
-            await self.advance_round(tc.round)
+            await self.advance_round(tc.round, via_tc=True)
             addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
             self.network.broadcast(addresses, encode_tc(tc))
             if self.name == self.leader_elector.get_leader(self.round):
                 await self.generate_proposal(tc)
+        elif timeout.round > self.round:
+            await self._maybe_amplify_timeout(timeout.round)
 
-    async def advance_round(self, round_: Round) -> None:
+    async def _maybe_amplify_timeout(self, round_: Round) -> None:
+        """Timeout amplification (the DiemBFT/Jolteon timeout-sync rule):
+        once f+1 DISTINCT authorities are seen timing out at a round
+        ahead of ours, join that view change by issuing our own timeout
+        for it — f+1 guarantees at least one honest node timed out there.
+
+        Why this is load-bearing (found by faultline chaos seed 11): the
+        TC is broadcast exactly once, best-effort. If that broadcast is
+        lost to a partition/lossy window, the committee splits across two
+        adjacent rounds — e.g. two nodes at r (their timeouts sign round
+        r) and two at r+1 (their timeouts sign r+1) — and NO round can
+        ever accumulate 2f+1 same-round timeouts again: a permanent
+        liveness wedge the timers cannot heal, observed as a total
+        post-heal commit stall. Amplification re-synchronizes the laggards
+        onto the newer round's view change, so the TC forms and the
+        committee converges within one timeout period."""
+        if round_ in self._amplified:
+            return
+        maker = self.aggregator.timeouts_aggregators.get(round_)
+        if maker is None:
+            return
+        weight = sum(self.committee.stake(a) for a in maker.used)
+        if weight < self.committee.validity_threshold():
+            return
+        self._amplified.add(round_)
+        log.warning(
+            "amplifying timeout to round %d (f+1 peers are there)", round_
+        )
+        telemetry.counter("consensus.timeouts_amplified").inc()
+        self.increase_last_voted_round(round_)
+        await self._persist_state()
+        timeout = await Timeout.new(
+            self.high_qc, round_, self.name, self.signature_service
+        )
+        self.timer.reset()
+        addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
+        self.network.broadcast(addresses, encode_timeout(timeout))
+        await self.handle_timeout(timeout)
+
+    async def advance_round(self, round_: Round, via_tc: bool = False) -> None:
         if round_ < self.round:
             return
         self.timer.reset()
         self.round = round_ + 1
+        # Entry-cause feed: a TC-entered round elects by round-robin in
+        # the reputation elector (the timeout-grind escape hatch — see
+        # leader.ReputationLeaderElector.note_round_entry). No-op for
+        # round-robin.
+        self.leader_elector.note_round_entry(self.round, via_tc)
         self._m_rounds.inc()
         self._g_round.set(self.round)
         if self._on_round_advance is not None:
@@ -553,6 +613,7 @@ class Core:
         self._verified_seats = {
             r: s for r, s in self._verified_seats.items() if r >= self.round
         }
+        self._amplified = {r for r in self._amplified if r >= self.round}
 
     async def generate_proposal(self, tc: TC | None) -> None:
         await self.tx_proposer.put(ProposerMake(self.round, self.high_qc, tc))
@@ -657,9 +718,14 @@ class Core:
         )
         await self.process_qc(block.qc)
         if block.tc is not None:
-            await self.advance_round(block.tc.round)
+            await self.advance_round(block.tc.round, via_tc=True)
         if (
-            author_mismatch
+            # Recomputed (not the early ``author_mismatch``): processing
+            # the block's TC above may have marked its round TC-entered,
+            # flipping a lenient elector to the round-robin fallback —
+            # the gate must judge the proposal against that same
+            # (post-certificate) leader opinion.
+            block.author != self.leader_elector.get_leader(block.round)
             and self.leader_elector.gate_active(block.round)
             and not self.synchronizer.requested(digest)
         ):
@@ -700,7 +766,7 @@ class Core:
         )
         if tc.round < self.round:
             return
-        await self.advance_round(tc.round)
+        await self.advance_round(tc.round, via_tc=True)
         if self.name == self.leader_elector.get_leader(self.round):
             await self.generate_proposal(tc)
 

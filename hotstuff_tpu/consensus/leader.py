@@ -64,6 +64,10 @@ class RRLeaderElector:
     def update(self, block) -> None:
         """Committed-block feed; round-robin keeps no state."""
 
+    def note_round_entry(self, round_: Round, via_tc: bool) -> None:
+        """Round-entry feed (see ReputationLeaderElector); round-robin is
+        already window-free and keeps no state."""
+
     def gate_active(self, round_: Round) -> bool:
         """Elector protocol (see ReputationLeaderElector.gate_active);
         unreachable for round-robin — strict mode rejects mismatched
@@ -94,6 +98,11 @@ class ReputationLeaderElector:
     #: Must exceed the 2-chain commit lag (2) plus processing skew.
     LAG = 6
 
+    #: TC-entered rounds remembered for the round-robin fallback (old
+    #: entries expire FIFO; the set only needs to cover rounds the core
+    #: still elects for — current, next, and recent block rounds).
+    TC_MEMORY = 64
+
     def __init__(
         self, committee: Committee, window: int = 10, exclude: int = 1
     ) -> None:
@@ -107,6 +116,10 @@ class ReputationLeaderElector:
         # evicted entries a less-advanced node still selects — identical
         # committed prefixes must yield identical electing sets.
         self._window: deque = deque(maxlen=window + self.LAG)
+        # Rounds entered through a TimeoutCertificate (timeout-grind
+        # killer — see note_round_entry).
+        self._tc_rounds: deque = deque(maxlen=self.TC_MEMORY)
+        self._tc_set: set = set()
 
     def _anchored(self, round_: Round) -> list:
         horizon = round_ - self.LAG
@@ -142,7 +155,41 @@ class ReputationLeaderElector:
             return  # genesis: nothing electable
         self._window.append((block.round, author, signers))
 
+    def note_round_entry(self, round_: Round, via_tc: bool) -> None:
+        """Round-entry feed from the Core (``advance_round``): whether
+        ``round_`` was reached through a QC or a TimeoutCertificate.
+
+        Why this exists — the residual "timeout grind" root cause: when
+        honest nodes' windows transiently DIVERGE (a straggler that
+        TC-advanced past its commit progress; the boot transition from
+        round-robin to window election under a vote split), rounds can
+        reach a regime where no candidate is self-elected AND endorsed
+        by a quorum. Nothing commits in a timeout round, so the windows
+        that caused the disagreement stay FROZEN — convergence waited on
+        a hash(round) coincidence, burning a full ``timeout_delay`` per
+        miss (observed as multi-second stalls with rounds advancing,
+        ~2/30 e2e runs). A round entered via TC therefore falls back to
+        ROUND-ROBIN election: window-free, so every honest node that saw
+        the round time out agrees on the next leader deterministically
+        — one wasted timeout is the worst case, the first post-TC
+        commit refills the windows, and window election resumes. (The
+        DiemBFT/Jolteon pacemakers use the same escape hatch.) Safety is
+        untouched: leader choice only gates votes and storage, never
+        quorum intersection.
+        """
+        if not via_tc:
+            return
+        if round_ not in self._tc_set:
+            if len(self._tc_rounds) == self._tc_rounds.maxlen:
+                self._tc_set.discard(self._tc_rounds[0])
+            self._tc_rounds.append(round_)
+            self._tc_set.add(round_)
+
     def get_leader(self, round_: Round) -> PublicKey:
+        if round_ in self._tc_set:
+            # TC-entered round: deterministic window-free fallback (see
+            # note_round_entry).
+            return self._sorted[round_ % len(self._sorted)]
         anchored = self._anchored(round_)
         active: set[PublicKey] = set()
         recent_authors: list[PublicKey] = []

@@ -35,6 +35,8 @@ import threading
 import time
 from concurrent.futures import ThreadPoolExecutor
 
+from hotstuff_tpu.faultline import hooks as _faultline
+
 log = logging.getLogger("network")
 
 _DIR = os.path.dirname(os.path.abspath(__file__))
@@ -132,6 +134,10 @@ def _load():
             ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32,
             ctypes.c_char_p, ctypes.c_uint32,
         ]
+        lib.hs_net_faults.restype = None
+        lib.hs_net_faults.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32
+        ]
         lib.hs_net_close_listener.restype = None
         lib.hs_net_close_listener.argtypes = [ctypes.c_void_p, ctypes.c_uint64]
         lib.hs_net_send.restype = None
@@ -170,7 +176,7 @@ STATS_FIELDS = (
     "pending", "inflight", "cancelled", "out_conns", "in_conns",
     "votes_batched", "votes_dropped", "votes_dropped_dup",
     "frames_rx", "bytes_rx", "frames_tx", "bytes_tx",
-    "writev_calls", "send_drops",
+    "writev_calls", "send_drops", "faults_dropped", "faults_delayed",
 )
 
 # Rate limit for the loop-side drop warnings (satellite: silent filtering
@@ -400,6 +406,19 @@ class NativeTransport:
             self._ctx, ctypes.c_uint64(lid), ctypes.c_uint64(round_)
         )
 
+    def set_faults(self, rules, seed: int = 0) -> None:
+        """Install the engine's test-only per-peer fault table
+        (``hs_net_faults``): ``rules`` maps ``(host, port)`` to
+        ``(drop_ppm, delay_ms)``; an empty mapping clears it. Applies to
+        best-effort frames only — the chaos plane's hook into the native
+        egress path (broadcast coalescing, writev pump, vote fan-in)."""
+        tokens = [f"seed:{seed}"] if seed else []
+        for (host, port), (drop_ppm, delay_ms) in rules.items():
+            resolved = self._resolve_fast(host) or host
+            tokens.append(f"{resolved}:{port}:{int(drop_ppm)}:{int(delay_ms)}")
+        spec = " ".join(tokens).encode()
+        self._lib.hs_net_faults(self._ctx, spec, len(spec))
+
     def stats(self) -> dict[str, int]:
         """Loop-thread state snapshot (tests / telemetry / ops). One call
         exports every engine counter; also drives the rate-limited drop
@@ -536,8 +555,15 @@ class NativeTransport:
                     fut = self._acks.pop(a, None)
                     if fut is not None and not fut.done():
                         fut.set_result(payload)
-                # _EV_GONE: inbound connection closed — nothing to do;
-                # receivers are connectionless from Python's view.
+                elif etype == _EV_GONE and b == 0:
+                    # conn_id 0 marks the LISTENER itself gone (an
+                    # add-listener stranded by engine shutdown closed the
+                    # fd loop-side): drop the phantom id so Python stops
+                    # tracking a listener that can never emit again.
+                    self._listeners.pop(a, None)
+                # _EV_GONE with a real conn_id: inbound connection
+                # closed — nothing to do; receivers are connectionless
+                # from Python's view.
 
 
 class _NativeFramedWriter:
@@ -654,6 +680,20 @@ class NativeReceiver:
                 undisclosed += len(frames)
                 continue
             conn_id = a
+            # Faultline ingress filter (``side: "recv"`` rules). The C++
+            # loop already ACKed auto-ack frames on arrival, so a drop
+            # here models app-level ingress loss (frame read off the
+            # wire, then eaten before dispatch).
+            plane = _faultline.plane
+            if plane is not None:
+                plan = plane.filter_recv(self.address)
+                if plan is not None:
+                    f_action, f_delay = plan
+                    if f_delay > 0:
+                        await asyncio.sleep(f_delay)
+                    if f_action == "drop":
+                        undisclosed += 1
+                        continue
             writer = (
                 acked if self.auto_ack
                 else _NativeFramedWriter(self._transport, conn_id)
@@ -679,17 +719,52 @@ class NativeSimpleSender:
         self._rng = random.Random()
 
     def send(self, address: tuple[str, int], data: bytes) -> None:
-        NativeTransport.get().send(address, data, reliable=False)
+        transport = NativeTransport.get()
+        plane = _faultline.plane
+        if plane is not None:
+            plan = plane.filter_send(address, data)
+            if plan is not None:
+                action, delay, copies = plan
+                if action == "drop":
+                    return
+                loop = asyncio.get_running_loop()
+                for _ in range(copies):
+                    loop.call_later(delay, transport.send, address, data)
+                return
+        transport.send(address, data, reliable=False)
 
     def broadcast(self, addresses: list[tuple[str, int]], data: bytes) -> None:
         # Coalesced: one command into the loop thread, one frame build.
-        NativeTransport.get().broadcast(addresses, data)
+        transport = NativeTransport.get()
+        plane = _faultline.plane
+        if plane is not None:
+            # Per-link faults split the fan-out: untouched peers keep the
+            # coalesced single-command path; dropped peers vanish; delayed
+            # or duplicated peers are re-issued individually.
+            clean: list[tuple[str, int]] = []
+            loop = None
+            for addr in addresses:
+                plan = plane.filter_send(addr, data)
+                if plan is None:
+                    clean.append(addr)
+                    continue
+                action, delay, copies = plan
+                if action == "drop":
+                    continue
+                if loop is None:
+                    loop = asyncio.get_running_loop()
+                for _ in range(copies):
+                    loop.call_later(delay, transport.send, addr, data)
+            if clean:
+                transport.broadcast(clean, data)
+            return
+        transport.broadcast(addresses, data)
 
     def lucky_broadcast(
         self, addresses: list[tuple[str, int]], data: bytes, nodes: int
     ) -> None:
         picked = self._rng.sample(addresses, min(nodes, len(addresses)))
-        NativeTransport.get().broadcast(picked, data)
+        self.broadcast(picked, data)
 
     def shutdown(self) -> None:
         pass  # connections are owned by the process-wide transport
@@ -732,6 +807,19 @@ class NativeReliableSender:
             if self._live.get(address, 0) < PENDING_CAP:
                 break
             await ev.wait()
+        # Faultline link filter: drops leave the ACK future pending
+        # forever (what a dead peer looks like — callers cancel after
+        # their quorum); delays reschedule the engine handoff without
+        # touching the caller. Duplicates are not applied to reliable
+        # sends (FIFO ACK pairing would mispair).
+        delay = 0.0
+        plane = _faultline.plane
+        if plane is not None:
+            plan = plane.filter_send(address, data)
+            if plan is not None:
+                action, delay, _copies = plan
+                if action == "drop":
+                    return asyncio.get_running_loop().create_future()
         msg_id = transport.alloc_msg_id()
         handler: asyncio.Future = asyncio.get_running_loop().create_future()
         self._live[address] = self._live.get(address, 0) + 1
@@ -746,7 +834,12 @@ class NativeReliableSender:
 
         handler.add_done_callback(on_done)
         transport._acks[msg_id] = handler
-        transport.send(address, data, reliable=True, msg_id=msg_id)
+        if delay > 0:
+            asyncio.get_running_loop().call_later(
+                delay, transport.send, address, data, True, msg_id
+            )
+        else:
+            transport.send(address, data, reliable=True, msg_id=msg_id)
         return handler
 
     async def broadcast(self, addresses: list[tuple[str, int]], data: bytes):

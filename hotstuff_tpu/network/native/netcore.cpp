@@ -122,6 +122,7 @@ enum : uint8_t {
   CMD_SET_VOTE_FILTER = 12,  // listener_id, payload = n*32 author keys
   CMD_SET_ROUND = 13,        // listener_id, count = stale-round cutoff
   CMD_BROADCAST = 14,        // host = "ip:port ip:port ...", payload once
+  CMD_SET_FAULTS = 15,       // payload = fault spec (hs_net_faults)
 };
 
 // Loop-thread state snapshot, serviced as a command so no lock covers the
@@ -148,6 +149,8 @@ struct StatsReq {
                               // the egress coalescing factor)
   uint64_t send_drops = 0;  // best-effort sends dropped at a peer's
                             // SIMPLE_QUEUE_CAP back-pressure bound
+  uint64_t faults_dropped = 0;  // frames eaten by the hs_net_faults table
+  uint64_t faults_delayed = 0;  // frames held by the hs_net_faults table
 };
 
 struct Command {
@@ -233,6 +236,24 @@ struct Listener {
   uint64_t vote_count = 0;
 };
 
+// Test-only per-peer fault injection (hs_net_faults): chaos scenarios
+// must also exercise the native egress path (broadcast coalescing, the
+// writev pump, the vote fan-in it feeds) under loss and latency. Rules
+// apply to BEST-EFFORT frames only (simple sends and broadcasts): the
+// reliable path's replay machinery gives injected loss there different
+// semantics, and the Python fault plane already covers it.
+struct PeerFault {
+  uint32_t drop_ppm = 0;   // parts-per-million drop probability
+  uint32_t delay_ms = 0;   // fixed hold before the frame enters the queue
+  uint64_t rng = 0;        // per-peer xorshift stream (seeded, replayable)
+};
+
+struct DelayedFrame {
+  std::string host;
+  uint16_t port;
+  std::string frame;  // already length-prefixed
+};
+
 struct AddrKey {
   std::string host;
   uint16_t port;
@@ -261,14 +282,24 @@ class NetCore {
     thread_ = std::thread([this] { loop(); });
   }
 
+  // Destroy contract: no other thread may be INSIDE any hs_net_* call
+  // (including the synchronous hs_net_stats/hs_net_stats_ex) when
+  // destroy begins — ctypes callers must sequence destroy after their
+  // last call returns. The narrower race — a push_cmd that took cmd_mu_
+  // BEFORE destroy but would have signalled the eventfd after the
+  // destructor closed it — is closed structurally: wake() runs while
+  // cmd_mu_ is still held (see push_cmd), and the destructor itself
+  // acquires cmd_mu_ below, so any in-flight enqueue has fully finished
+  // (wake included) before CMD_STOP is even queued, and cmd_efd_ is
+  // closed only after thread_.join().
   ~NetCore() {
     {
       std::lock_guard<std::mutex> g(cmd_mu_);
       Command c;
       c.type = CMD_STOP;
       commands_.push_back(std::move(c));
+      wake();
     }
-    wake();
     thread_.join();
     for (auto& [id, c] : in_conns_) close(c.fd);
     for (auto& [k, c] : out_conns_) {
@@ -326,12 +357,17 @@ class NetCore {
   // (CMD_STOP processed): a command pushed after that would never be
   // serviced, which matters for synchronous requests (CMD_STATS) whose
   // caller blocks on completion.
+  //
+  // wake() runs UNDER cmd_mu_, not after it: released-then-wake left a
+  // window where a thread enqueuing just before destroy could write to a
+  // cmd_efd_ the destructor had already closed (or the kernel had
+  // reused). With the signal inside the critical section, the destructor
+  // — which must take cmd_mu_ to enqueue CMD_STOP — cannot proceed until
+  // any in-flight enqueue+wake has fully completed.
   bool push_cmd(Command&& c) {
-    {
-      std::lock_guard<std::mutex> g(cmd_mu_);
-      if (!accepting_) return false;
-      commands_.push_back(std::move(c));
-    }
+    std::lock_guard<std::mutex> g(cmd_mu_);
+    if (!accepting_) return false;
+    commands_.push_back(std::move(c));
     wake();
     return true;
   }
@@ -431,6 +467,7 @@ class NetCore {
         }
       }
       flush_vote_batches();
+      flush_delayed_frames(now);
       // Reconnect timers: disconnected reliable connections redial on
       // their backoff schedule whether or not traffic is queued (the
       // reference's keep_alive loop does the same).
@@ -456,11 +493,16 @@ class NetCore {
         s->done = true;  // zeros: the loop is gone, nothing is live
         s->cv.notify_one();
       } else if (c.type == CMD_ADD_LISTENER && c.fd >= 0) {
-        // listen_on bound it; nobody else will close it. (Its caller
-        // already got a valid id in this narrow window — acceptable:
-        // listen never races destroy in the Python threading model,
-        // and a phantom listener on a closed fd only misses events.)
+        // listen_on bound it; nobody else will close it. Its caller
+        // already holds a "valid" listener id, so closing the fd alone
+        // would leave Python tracking a phantom listener forever. Emit
+        // an EV_GONE with conn_id 0 — the "listener itself is gone"
+        // marker (real inbound conn ids start at 1) — so the wrapper
+        // drops the id from its table. The event buffer and out_efd_
+        // outlive the loop thread (closed only in the destructor), so
+        // a caller still draining picks it up.
         close(c.fd);
+        emit(Event{EV_GONE, c.id, 0, {}});
       }
     }
   }
@@ -474,6 +516,11 @@ class NetCore {
         if (d < 0) d = 0;
         if (best < 0 || d < best) best = d;
       }
+    }
+    if (!delayed_frames_.empty()) {
+      int64_t d = int64_t(delayed_frames_.begin()->first) - int64_t(now);
+      if (d < 0) d = 0;
+      if (best < 0 || d < best) best = d;
     }
     return int(best);
   }
@@ -526,6 +573,9 @@ class NetCore {
           break;
         case CMD_BROADCAST:
           broadcast_simple(c.host, c.payload);
+          break;
+        case CMD_SET_FAULTS:
+          set_faults(c.payload);
           break;
         case CMD_SET_VOTE_FILTER: {
           auto it = listeners_.find(c.id);
@@ -631,6 +681,8 @@ class NetCore {
           s->bytes_tx = bytes_tx_;
           s->writev_calls = writev_calls_;
           s->send_drops = send_drops_;
+          s->faults_dropped = faults_dropped_;
+          s->faults_delayed = faults_delayed_;
           {
             // notify under the lock: after the unlock the waiter may
             // (spurious wakeup) observe done and destroy the
@@ -859,6 +911,93 @@ class NetCore {
     epoll_ctl(epfd_, EPOLL_CTL_MOD, c.fd, &ev);
   }
 
+  // ---- fault injection (hs_net_faults) ----
+
+  static uint64_t xorshift64(uint64_t& s) {
+    s ^= s << 13;
+    s ^= s >> 7;
+    s ^= s << 17;
+    return s;
+  }
+
+  // Parse the fault spec (loop thread): whitespace-separated tokens,
+  // "seed:<u64>" or "<ip>:<port>:<drop_ppm>:<delay_ms>". An empty spec
+  // clears the table. Per-peer RNG streams derive from the seed and the
+  // peer key, so the same seed + same frame sequence replays the same
+  // drop pattern.
+  void set_faults(const std::string& spec) {
+    fault_peers_.clear();
+    uint64_t seed = 0x9e3779b97f4a7c15ull;
+    size_t pos = 0;
+    while (pos < spec.size()) {
+      size_t end = spec.find(' ', pos);
+      if (end == std::string::npos) end = spec.size();
+      std::string tok = spec.substr(pos, end - pos);
+      pos = end + 1;
+      if (tok.empty()) continue;
+      if (tok.rfind("seed:", 0) == 0) {
+        seed = strtoull(tok.c_str() + 5, nullptr, 10);
+        continue;
+      }
+      // ip:port:drop_ppm:delay_ms (rightmost-first split keeps IPv4 ':'
+      // out of the picture — hosts here are dotted quads).
+      size_t p3 = tok.rfind(':');
+      size_t p2 = p3 == std::string::npos ? p3 : tok.rfind(':', p3 - 1);
+      size_t p1 = p2 == std::string::npos ? p2 : tok.rfind(':', p2 - 1);
+      if (p1 == std::string::npos || p1 == 0) continue;
+      std::string peer = tok.substr(0, p2);  // "ip:port"
+      PeerFault f;
+      f.drop_ppm = uint32_t(strtoul(tok.c_str() + p2 + 1, nullptr, 10));
+      f.delay_ms = uint32_t(strtoul(tok.c_str() + p3 + 1, nullptr, 10));
+      f.rng = (seed ^ std::hash<std::string>()(peer)) | 1;  // nonzero
+      fault_peers_[peer] = f;
+    }
+  }
+
+  // True when the fault table consumed the frame (dropped, or parked for
+  // delayed delivery). Best-effort frames only — callers on the reliable
+  // path never consult this (replay semantics would turn injected loss
+  // into duplicate delivery, which the Python fault plane models
+  // explicitly instead).
+  bool fault_intercept(const std::string& host, uint16_t port,
+                       const std::string& frame) {
+    if (fault_peers_.empty()) return false;
+    std::string peer = host + ":" + std::to_string(port);
+    auto it = fault_peers_.find(peer);
+    if (it == fault_peers_.end()) return false;
+    PeerFault& f = it->second;
+    if (f.drop_ppm != 0 && xorshift64(f.rng) % 1000000u < f.drop_ppm) {
+      faults_dropped_++;
+      return true;
+    }
+    if (f.delay_ms != 0) {
+      delayed_frames_.emplace(now_ms() + f.delay_ms,
+                              DelayedFrame{host, port, frame});
+      faults_delayed_++;
+      return true;
+    }
+    return false;
+  }
+
+  void flush_delayed_frames(uint64_t now) {
+    while (!delayed_frames_.empty() &&
+           delayed_frames_.begin()->first <= now) {
+      DelayedFrame df = std::move(delayed_frames_.begin()->second);
+      delayed_frames_.erase(delayed_frames_.begin());
+      OutConn& c = out_conn(df.host, df.port, false);
+      if (c.pending.size() >= SIMPLE_QUEUE_CAP) {
+        send_drops_++;
+        continue;
+      }
+      PendingMsg m;
+      m.msg_id = 0;
+      m.frame = std::move(df.frame);
+      c.pending.push_back(std::move(m));
+      if (c.fd < 0 && !c.connecting) start_connect(c);
+      if (c.fd >= 0 && !c.connecting) pump_out(c);
+    }
+  }
+
   // ---- outbound ----
 
   OutConn& out_conn(const std::string& host, uint16_t port, bool reliable) {
@@ -879,15 +1018,16 @@ class NetCore {
 
   void send_simple(const std::string& host, uint16_t port,
                    const std::string& payload) {
+    PendingMsg m;
+    m.msg_id = 0;
+    frame_append(m.frame, reinterpret_cast<const uint8_t*>(payload.data()),
+                 uint32_t(payload.size()));
+    if (fault_intercept(host, port, m.frame)) return;
     OutConn& c = out_conn(host, port, false);
     if (c.pending.size() >= SIMPLE_QUEUE_CAP) {  // best-effort drop
       send_drops_++;
       return;
     }
-    PendingMsg m;
-    m.msg_id = 0;
-    frame_append(m.frame, reinterpret_cast<const uint8_t*>(payload.data()),
-                 uint32_t(payload.size()));
     c.pending.push_back(std::move(m));
     if (c.fd < 0 && !c.connecting) start_connect(c);
     if (c.fd >= 0 && !c.connecting) pump_out(c);
@@ -910,6 +1050,10 @@ class NetCore {
         std::string host = addrs.substr(pos, colon - pos);
         uint16_t port =
             uint16_t(strtoul(addrs.c_str() + colon + 1, nullptr, 10));
+        if (fault_intercept(host, port, frame)) {
+          pos = sp + 1;
+          continue;
+        }
         OutConn& c = out_conn(host, port, false);
         if (c.pending.size() < SIMPLE_QUEUE_CAP) {
           PendingMsg m;
@@ -1213,12 +1357,17 @@ class NetCore {
   uint64_t bytes_tx_ = 0;
   uint64_t writev_calls_ = 0;
   uint64_t send_drops_ = 0;
+  uint64_t faults_dropped_ = 0;
+  uint64_t faults_delayed_ = 0;
 
   std::unordered_map<uint64_t, Listener> listeners_;  // loop thread only
   std::unordered_map<uint64_t, InConn> in_conns_;
   std::unordered_map<AddrKey, OutConn, AddrKeyHash> out_conns_;
   std::unordered_map<uint64_t, AddrKey> out_by_slot_;
   std::unordered_set<uint64_t> cancelled_;
+  // hs_net_faults state (loop thread only).
+  std::unordered_map<std::string, PeerFault> fault_peers_;
+  std::multimap<uint64_t, DelayedFrame> delayed_frames_;  // release_ms
 };
 
 }  // namespace
@@ -1301,6 +1450,18 @@ void hs_net_broadcast(void* ctx, const char* addrs, uint32_t addrs_len,
   static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
 }
 
+// Test-only per-peer fault-injection table (the chaos plane's native
+// hook): ``spec`` is whitespace-separated tokens — "seed:<u64>" and
+// "<ip>:<port>:<drop_ppm>:<delay_ms>" — replacing the whole table; an
+// empty spec clears it. Rules affect best-effort frames only (simple
+// sends + broadcasts). Never enable in production deployments.
+void hs_net_faults(void* ctx, const char* spec, uint32_t spec_len) {
+  Command c;
+  c.type = CMD_SET_FAULTS;
+  c.payload.assign(spec, spec_len);
+  static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
 void hs_net_close_listener(void* ctx, uint64_t listener_id) {
   Command c;
   c.type = CMD_CLOSE_LISTENER;
@@ -1338,6 +1499,14 @@ int64_t hs_net_drain(void* ctx, uint8_t* buf, uint32_t cap) {
 // out[7] = {pending, inflight, cancelled, out_conns, in_conns,
 // votes_batched, votes_dropped}. Blocks until the loop thread services
 // the request (microseconds when live).
+//
+// Destroy contract (applies to hs_net_stats_ex too): this call must not
+// race hs_net_destroy — the caller blocks on loop-thread servicing, and
+// a context freed mid-wait is a use-after-free no in-library ordering
+// can repair. The ctypes wrapper sequences destroy after every other
+// call has returned; a call that merely LOSES the race to shutdown (the
+// loop already exited but the context is alive) safely returns zeros
+// via the push_cmd(false) path below.
 void hs_net_stats(void* ctx, uint64_t* out) {
   StatsReq req;
   Command c;
@@ -1363,10 +1532,11 @@ void hs_net_stats(void* ctx, uint64_t* out) {
 // Extended snapshot: fills up to ``cap`` slots in the order
 // {pending, inflight, cancelled, out_conns, in_conns, votes_batched,
 //  votes_dropped, votes_dropped_dup, frames_rx, bytes_rx, frames_tx,
-//  bytes_tx, writev_calls, send_drops} and returns the number filled
-// (new fields append, existing indices never move — callers probe the
-// return value instead of pinning a struct version). Same loop-thread
-// servicing as hs_net_stats.
+//  bytes_tx, writev_calls, send_drops, faults_dropped, faults_delayed}
+// and returns the number filled (new fields append, existing indices
+// never move — callers probe the return value instead of pinning a
+// struct version). Same loop-thread servicing — and the same
+// no-race-with-destroy contract — as hs_net_stats.
 int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
   if (out == nullptr || cap <= 0) return 0;
   StatsReq req;
@@ -1375,18 +1545,19 @@ int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
   c.ptr = &req;
   if (!static_cast<NetCore*>(ctx)->push_cmd(std::move(c))) {
     for (int i = 0; i < cap; i++) out[i] = 0;
-    return cap < 14 ? cap : 14;
+    return cap < 16 ? cap : 16;
   }
   std::unique_lock<std::mutex> lk(req.mu);
   req.cv.wait(lk, [&] { return req.done; });
-  const uint64_t fields[14] = {
+  const uint64_t fields[16] = {
       req.pending,       req.inflight,     req.cancelled,
       req.out_conns,     req.in_conns,     req.votes_batched,
       req.votes_dropped, req.votes_dropped_dup, req.frames_rx,
       req.bytes_rx,      req.frames_tx,    req.bytes_tx,
-      req.writev_calls,  req.send_drops,
+      req.writev_calls,  req.send_drops,   req.faults_dropped,
+      req.faults_delayed,
   };
-  int n = cap < 14 ? cap : 14;
+  int n = cap < 16 ? cap : 16;
   for (int i = 0; i < n; i++) out[i] = fields[i];
   return n;
 }

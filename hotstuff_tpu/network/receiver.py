@@ -8,6 +8,7 @@ import logging
 import struct
 
 from hotstuff_tpu import telemetry
+from hotstuff_tpu.faultline import hooks as _faultline
 
 log = logging.getLogger("network")
 
@@ -122,6 +123,19 @@ class Receiver:
                 frame = await read_frame(reader)
                 m_frames.inc()
                 m_bytes.inc(len(frame) + 4)
+                # Faultline ingress filter (``side: "recv"`` link rules):
+                # a dropped frame vanishes before the ACK — the sender
+                # sees exactly what a lossy ingress NIC produces; a delay
+                # stalls this in-order connection, as real queueing would.
+                plane = _faultline.plane
+                if plane is not None:
+                    plan = plane.filter_recv(self.address)
+                    if plan is not None:
+                        action, delay = plan
+                        if delay > 0:
+                            await asyncio.sleep(delay)
+                        if action == "drop":
+                            continue
                 if self.auto_ack:
                     write_frame(writer, b"Ack")
                     # drain() keeps flow control: a peer that floods

@@ -15,6 +15,7 @@ import random
 import struct
 
 from hotstuff_tpu import telemetry
+from hotstuff_tpu.faultline import hooks as _faultline
 
 from .budget import BUDGET
 from .receiver import read_frame
@@ -118,6 +119,23 @@ class SimpleSender:
         self._rng = random.Random()
 
     def _send_framed(self, address: tuple[str, int], framed: bytes) -> None:
+        # Faultline link filter (one module-global load when disabled):
+        # the active FaultPlane may drop this frame, delay it, or fan it
+        # out as duplicates — per-link, seeded, and counted.
+        plane = _faultline.plane
+        if plane is not None:
+            plan = plane.filter_send(address, framed, payload_off=4)
+            if plan is not None:
+                action, delay, copies = plan
+                if action == "drop":
+                    return
+                loop = asyncio.get_running_loop()
+                for _ in range(copies):
+                    loop.call_later(delay, self._deliver_framed, address, framed)
+                return
+        self._deliver_framed(address, framed)
+
+    def _deliver_framed(self, address: tuple[str, int], framed: bytes) -> None:
         conn = self._connections.get(address)
         if conn is None or not conn.try_send(framed):
             conn = _Connection(address)

@@ -7,6 +7,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from hotstuff_tpu import telemetry
 from hotstuff_tpu.consensus import Consensus
@@ -21,6 +22,48 @@ log = logging.getLogger("node")
 CHANNEL_CAPACITY = 1_000
 
 
+def _committee_node_names(committee: Committee) -> dict:
+    """Deterministic scenario names for a committee's members: sort by
+    consensus address and name positionally (``n000``...). Every process
+    reading the same committee file derives the same mapping, so one
+    scenario file coordinates a whole LocalBench/netns deployment."""
+    ordered = sorted(
+        committee.consensus.authorities.items(),
+        key=lambda kv: kv[1].address,
+    )
+    return {pk: f"n{i:03d}" for i, (pk, _) in enumerate(ordered)}
+
+
+def _install_faultline_from_env(committee: Committee, name) -> None:
+    """``HOTSTUFF_FAULTLINE=<scenario.json>`` arms this process's fault
+    plane: the scenario compiles against the committee-derived node names
+    and the plane starts at process boot (virtual t=0 ≈ node boot; the
+    few hundred ms of boot skew between processes is noise at scenario
+    timescales). The node's own identity comes from its key."""
+    scenario_path = os.environ.get("HOTSTUFF_FAULTLINE")
+    if not scenario_path:
+        return
+    from hotstuff_tpu.faultline import FaultPlane, Scenario, hooks, install
+
+    names = _committee_node_names(committee)
+    addr_to_node: dict = {}
+    consensus_addrs = set()
+    for pk, auth in committee.consensus.authorities.items():
+        addr_to_node[tuple(auth.address)] = names[pk]
+        consensus_addrs.add(tuple(auth.address))
+    for pk, auth in committee.mempool.authorities.items():
+        addr_to_node[tuple(auth.mempool_address)] = names[pk]
+    scenario = Scenario.load(scenario_path)
+    schedule = scenario.compile(sorted(names.values()))
+    plane = FaultPlane(schedule, addr_to_node, consensus_addrs)
+    install(plane).start()
+    hooks.NODE.set(names[name])
+    log.info(
+        "faultline armed from %s as %s (seed %d)",
+        scenario_path, names[name], scenario.seed,
+    )
+
+
 class Node:
     def __init__(self) -> None:
         self.commit: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
@@ -28,6 +71,8 @@ class Node:
         self.consensus: Consensus | None = None
         self.store: Store | None = None
         self.telemetry_emitter: telemetry.TelemetryEmitter | None = None
+        self.crashed = False
+        self._boot: tuple | None = None  # (secret, committee, parameters, benchmark)
 
     @classmethod
     async def new(
@@ -45,6 +90,11 @@ class Node:
             Parameters.read(parameters_file) if parameters_file else Parameters.default()
         )
         self.store = Store(store_path)
+        self._boot = (secret, committee, parameters, benchmark)
+        # Arm fault injection BEFORE any actor spawns: the faultline node
+        # identity is a contextvar, and tasks inherit the context they
+        # were created in.
+        _install_faultline_from_env(committee, secret.name)
 
         signature_service = SignatureService(secret.secret)
 
@@ -94,6 +144,82 @@ class Node:
         (reference ``node/src/node.rs:76-80``)."""
         while True:
             await self.commit.get()
+
+    # -- supervised crash/restart (the faultline contract) -------------------
+
+    async def crash(self) -> None:
+        """Kill the node the UNCLEAN way — cancel every actor task and
+        yank the listeners, no graceful drains — modeling a process
+        crash while keeping the store open (it is the node's disk, and
+        the restart must exercise real recovery from persisted state:
+        ``Core._restore_state`` round/vote/high_qc replay)."""
+        if self.crashed:
+            return
+        if self.consensus is not None:
+            for t in self.consensus.tasks:
+                t.cancel()
+            if self.consensus.synchronizer is not None:
+                self.consensus.synchronizer.shutdown()
+            if self.consensus.mempool_driver is not None:
+                self.consensus.mempool_driver.shutdown()
+            for r in self.consensus.receivers:
+                server = getattr(r, "_server", None)
+                if server is not None:  # asyncio transport: unclean
+                    r._closing = True
+                    server.close()
+                    for task in list(r._conn_tasks):
+                        task.cancel()
+                    for w in list(r._writers):
+                        w.transport.abort()
+                else:  # native transport: release the listener id
+                    await r.shutdown()
+            self.consensus = None
+        if self.mempool is not None:
+            for t in self.mempool.tasks:
+                t.cancel()
+            for r in self.mempool.receivers:
+                await r.shutdown()
+            self.mempool = None
+        self.crashed = True
+        telemetry.counter("faultline.injected.crashes").inc()
+        log.warning("Node crashed (supervised)")
+
+    async def restart(self) -> "Node":
+        """Bring a crashed node back on the SAME store: consensus state
+        (round, last vote, high QC) restores from the persisted record,
+        exactly like a process restarting on its disk."""
+        if not self.crashed:
+            return self
+        assert self._boot is not None, "restart() before new()"
+        secret, committee, parameters, benchmark = self._boot
+        signature_service = SignatureService(secret.secret)
+        tx_consensus_to_mempool: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        tx_mempool_to_consensus: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+        self.mempool = Mempool(
+            secret.name,
+            committee.mempool,
+            parameters.mempool,
+            self.store,
+            tx_consensus_to_mempool,
+            tx_mempool_to_consensus,
+            benchmark=benchmark,
+        )
+        await self.mempool.spawn()
+        self.consensus = await Consensus.spawn(
+            secret.name,
+            committee.consensus,
+            parameters.consensus,
+            signature_service,
+            self.store,
+            tx_mempool_to_consensus,
+            tx_consensus_to_mempool,
+            self.commit,
+            benchmark=benchmark,
+        )
+        self.crashed = False
+        telemetry.counter("faultline.injected.restarts").inc()
+        log.info("Node restarted (supervised)")
+        return self
 
     async def shutdown(self) -> None:
         if self.consensus is not None:

@@ -31,7 +31,18 @@ _PROPOSE, _VOTE, _QC = 0, 1, 2
 
 
 class RoundTrace:
-    __slots__ = ("_rounds", "_max_rounds", "_h_pv", "_h_vq", "_h_qc", "_h_pc")
+    __slots__ = (
+        "_rounds", "_max_rounds", "_h_pv", "_h_vq", "_h_qc", "_h_pc",
+        "_h_pc_faulted", "_c_faulted",
+    )
+
+    #: fault annotation hook: a zero-arg callable set by
+    #: ``faultline.runtime.install`` that reports whether fault injection
+    #: is currently active. Rounds whose commit closes under active
+    #: faults are recorded into the ``...propose_to_commit_faulted_ms``
+    #: histogram instead of the clean one (and counted), so chaos runs
+    #: separate degraded-round latency from steady-state latency.
+    fault_flag = None
 
     def __init__(self, registry: Registry, max_rounds: int = 512) -> None:
         # round -> [propose_ts, first_vote_ts, qc_ts] (None until marked)
@@ -42,6 +53,10 @@ class RoundTrace:
         self._h_vq = h("consensus.span.first_vote_to_qc_ms", DURATION_MS_BUCKETS)
         self._h_qc = h("consensus.span.qc_to_commit_ms", DURATION_MS_BUCKETS)
         self._h_pc = h("consensus.span.propose_to_commit_ms", DURATION_MS_BUCKETS)
+        self._h_pc_faulted = h(
+            "consensus.span.propose_to_commit_faulted_ms", DURATION_MS_BUCKETS
+        )
+        self._c_faulted = registry.counter("consensus.span.faulted_rounds")
 
     def _marks(self, round_: int) -> list[float | None]:
         marks = self._rounds.get(round_)
@@ -79,7 +94,12 @@ class RoundTrace:
             if marks[_QC] is not None:
                 self._h_qc.observe((now - marks[_QC]) * 1e3)
             if marks[_PROPOSE] is not None:
-                self._h_pc.observe((now - marks[_PROPOSE]) * 1e3)
+                flag = RoundTrace.fault_flag
+                if flag is not None and flag():
+                    self._c_faulted.inc()
+                    self._h_pc_faulted.observe((now - marks[_PROPOSE]) * 1e3)
+                else:
+                    self._h_pc.observe((now - marks[_PROPOSE]) * 1e3)
         while self._rounds:
             oldest = next(iter(self._rounds))
             if oldest > round_:

@@ -180,6 +180,82 @@ async def test_timeout_duplicate_dropped_before_verification():
         Timeout.verify = orig
 
 
+@async_test
+async def test_timeout_amplification_rejoins_higher_round():
+    """Timeout-sync regression (faultline chaos seed 11): a lost TC
+    broadcast can split the committee across adjacent rounds — two nodes
+    timing out at r, two at r+1 — where no round can ever gather 2f+1
+    same-round timeouts again (permanent wedge). On seeing f+1 distinct
+    timeouts for a round ahead of ours, the core must JOIN that view
+    change: broadcast its own timeout for that round and seat it, so the
+    TC forms and every node re-converges."""
+    from hotstuff_tpu.consensus.aggregator import Aggregator
+    from hotstuff_tpu.consensus.core import Core
+    from hotstuff_tpu.consensus.leader import RRLeaderElector
+    from hotstuff_tpu.consensus.timer import Timer
+
+    kl = keys(4)
+    committee = consensus_committee(BASE + 100)
+    pk, sk = kl[0]
+
+    class _SpySender:
+        def __init__(self):
+            self.broadcasts = []
+
+        def broadcast(self, addresses, data):
+            self.broadcasts.append(data)
+
+        def send(self, address, data):
+            pass
+
+    core = Core.__new__(Core)  # state-only instance: no tasks
+    core.name = pk
+    core.committee = committee
+    core.round = 5
+    core.last_voted_round = 4
+    core.last_committed_round = 0
+    core.persist_sync = False
+    core.high_qc = QC.genesis()
+    core.aggregator = Aggregator(committee)
+    core.leader_elector = RRLeaderElector(committee)
+    core._cert_cache = CertificateCache()
+    core._amplified = set()
+    core._bad_sigs = {}
+    core._verified_seats = {}
+    core.signature_service = SignatureService(sk)
+    core.store = Store()
+    core.timer = Timer(60_000)
+    core.network = _SpySender()
+    core.tx_proposer = asyncio.Queue()
+    core._on_round_advance = None
+
+    # One peer ahead at round 7: below f+1, no amplification.
+    t1 = Timeout.new_from_key(QC.genesis(), 7, kl[1][0], kl[1][1])
+    await Core.handle_timeout(core, t1)
+    assert core.round == 5 and 7 not in core._amplified
+
+    # Second distinct peer reaches f+1 = 2: the core must amplify —
+    # sign its own round-7 timeout (persisted first), broadcast it, and
+    # seat it, which completes the 2f+1 TC and advances the round.
+    t2 = Timeout.new_from_key(QC.genesis(), 7, kl[2][0], kl[2][1])
+    await Core.handle_timeout(core, t2)
+    assert core.last_voted_round == 7  # never votes below the joined round
+    assert core.round == 8, "TC(7) should have formed and advanced the round"
+    from hotstuff_tpu.consensus.messages import TAG_TC, TAG_TIMEOUT
+
+    tags = [b[0] for b in core.network.broadcasts]
+    assert TAG_TIMEOUT in tags and TAG_TC in tags
+
+    # Retransmissions must not re-amplify (one own timeout per round).
+    n_broadcasts = len(core.network.broadcasts)
+    t2b = Timeout.new_from_key(QC.genesis(), 9, kl[2][0], kl[2][1])
+    await Core.handle_timeout(core, t2b)
+    t2c = Timeout.new_from_key(QC.genesis(), 9, kl[2][0], kl[2][1])
+    await Core.handle_timeout(core, t2c)  # same author again: no f+1
+    assert 9 not in core._amplified
+    assert len(core.network.broadcasts) == n_broadcasts
+
+
 def test_certificate_cache_skips_byte_identical_and_only_those():
     """A byte-identical QC that verified once skips re-verification; any
     tampered variant misses the cache and fails from scratch."""

@@ -1,0 +1,150 @@
+"""The reputation-elector "timeout grind" regression (closes the round-4
+heisenbug; replaces the always-clean ``benchmark/diag_reputation.py``).
+
+Root cause, pinned with faultline's deterministic scheduling: when honest
+nodes' committed windows transiently DIVERGE (a straggler that
+TC-advanced past its commit progress, or the boot transition from
+round-robin to window election under a vote split), the committee can
+enter rounds where no candidate is both self-elected and endorsed by a
+quorum. Nothing commits in a timeout round, so the windows that caused
+the disagreement stay FROZEN — convergence waited on a hash(round)
+coincidence, burning a full ``timeout_delay`` per miss (multi-second
+stalls with rounds advancing; ~2/30 e2e reproductions).
+
+Fix under test: a round entered via TimeoutCertificate elects by
+ROUND-ROBIN (``ReputationLeaderElector.note_round_entry``) — window-free
+and therefore identical on every node that saw the timeout, so the grind
+is bounded at one wasted timeout regardless of window divergence.
+"""
+
+import pytest
+
+from hotstuff_tpu.consensus.leader import ReputationLeaderElector, RRLeaderElector
+from hotstuff_tpu.faultline import Scenario
+
+from .common import async_test, chain, consensus_committee, keys
+
+BASE = 25600
+
+
+def _divergent_electors():
+    """Two electors over the SAME chain but with one node lagging two
+    commits — the exact transient the commit-batching skew produces."""
+    committee = consensus_committee(BASE)
+    blocks = chain(12)
+    ahead = ReputationLeaderElector(committee)
+    behind = ReputationLeaderElector(committee)
+    for blk in blocks:
+        ahead.update(blk)
+    for blk in blocks[:-2]:
+        behind.update(blk)
+    return committee, ahead, behind, blocks
+
+
+def test_divergent_windows_disagree_without_tc_fallback():
+    """The root cause, demonstrated: a two-commit lag makes the electors
+    disagree on at least one upcoming round's leader — each such round
+    under a frozen window burns a full timeout."""
+    _, ahead, behind, blocks = _divergent_electors()
+    start = blocks[-1].round + ReputationLeaderElector.LAG
+    picks = [
+        (ahead.get_leader(r), behind.get_leader(r))
+        for r in range(start - 3, start + 6)
+    ]
+    assert any(a != b for a, b in picks), (
+        "fixture no longer produces divergent elections; rebuild it "
+        "with a different lag"
+    )
+
+
+def test_tc_entered_round_elects_round_robin_on_every_node():
+    """The fix: marking a round TC-entered flips BOTH electors to the
+    same deterministic round-robin leader, whatever their windows say."""
+    committee, ahead, behind, blocks = _divergent_electors()
+    rr = RRLeaderElector(committee)
+    start = blocks[-1].round + ReputationLeaderElector.LAG
+    for r in range(start - 3, start + 6):
+        ahead.note_round_entry(r, via_tc=True)
+        behind.note_round_entry(r, via_tc=True)
+        assert ahead.get_leader(r) == behind.get_leader(r) == rr.get_leader(r)
+    # Rounds NOT entered via TC keep window-based election.
+    far = start + 100
+    ahead.note_round_entry(far, via_tc=False)
+    assert far not in ahead._tc_set
+
+
+def test_tc_memory_is_bounded():
+    committee = consensus_committee(BASE)
+    rep = ReputationLeaderElector(committee)
+    for r in range(10_000):
+        rep.note_round_entry(r, via_tc=True)
+    assert len(rep._tc_set) <= ReputationLeaderElector.TC_MEMORY
+    assert len(rep._tc_rounds) <= ReputationLeaderElector.TC_MEMORY
+    # Oldest marks expired; newest retained.
+    assert 9_999 in rep._tc_set and 0 not in rep._tc_set
+
+
+def test_rr_elector_accepts_round_entry_feed():
+    committee = consensus_committee(BASE)
+    rr = RRLeaderElector(committee)
+    rr.note_round_entry(7, via_tc=True)  # must be a no-op, not an error
+    assert rr.get_leader(7) == committee.sorted_keys()[7 % 4]
+
+
+@async_test(timeout=150)
+async def test_reputation_committee_survives_grind_scenario():
+    """Seeded e2e regression: the grind-inducing storm — a silent leader
+    (every election of that seat burns a timeout round, forcing repeated
+    TC entries) plus a partition straggler (TC-advanced window
+    divergence) — on a live reputation-elector committee. The checker
+    must report safety=ok and post-heal commit recovery. Pre-fix this
+    scenario ground through hash-coincidence timeouts; post-fix every
+    TC round re-converges on the round-robin leader."""
+    from hotstuff_tpu.faultline import run_scenario
+
+    scenario = Scenario(
+        name="reputation-grind", seed=413, duration_s=8.0,
+        events=[
+            # The committee builds full windows, then one node is cut
+            # away while the rest keep committing (its window goes
+            # stale), and a silent leader forces timeout rounds right as
+            # the partition heals.
+            {"kind": "partition", "groups": [[3], [0, 1, 2]],
+             "at": 1.0, "until": 4.0},
+            {"kind": "byzantine", "node": 0, "behavior": "silent_leader",
+             "at": 3.5, "until": 6.0},
+        ],
+    )
+    result = await run_scenario(
+        scenario, 4, base_port=BASE + 20, timeout_delay=500,
+        leader_elector="reputation", recovery_timeout_s=60.0,
+    )
+    verdict = result["verdict"]
+    assert verdict["safety"]["ok"], verdict["safety"]
+    assert verdict["liveness"]["recovered"], verdict["liveness"]
+
+
+@pytest.mark.slow
+@async_test(timeout=300)
+async def test_reputation_grind_seed_sweep():
+    """The captured reproductions: chaos seeds 11 and 12 ground a
+    pre-fix reputation committee to a TOTAL post-heal stall (zero
+    commits in 25 s of recovery window, rounds still advancing) in the
+    seeded hunt that pinned this bug. With the TC round-robin fallback
+    both recover. Keep these seeds verbatim — they are the only known
+    deterministic schedules that reached the frozen-divergent-window
+    regime at N=4."""
+    from hotstuff_tpu.faultline import chaos_scenario, run_scenario
+
+    for i, seed in enumerate((11, 12)):
+        scenario = chaos_scenario(
+            seed, duration_s=8.0, crashes=1, partitions=1, byzantine=1,
+            links=1,
+        )
+        result = await run_scenario(
+            scenario, 4, base_port=BASE + 40 + i * 8, timeout_delay=500,
+            leader_elector="reputation", recovery_timeout_s=60.0,
+        )
+        verdict = result["verdict"]
+        assert verdict["safety"]["ok"], (seed, verdict["safety"])
+        assert verdict["liveness"]["recovered"], (seed, verdict["liveness"])
