@@ -60,7 +60,12 @@ async def run_committee(
             telemetry_path,
             node=f"committee-{n}",
             interval_s=telemetry.env_interval_s(),
-        ).spawn()
+            # Cross-node trace events ride the same stream: every
+            # engine's RoundTrace labels its events with its key, so one
+            # in-process stream carries the whole committee's timelines
+            # (benchmark/trace_assemble.py merges them per round).
+            trace=telemetry.trace_buffer(),
+        )
 
     keys = [generate_keypair() for _ in range(n)]
     committee = Committee(
@@ -101,6 +106,15 @@ async def run_committee(
 
     # Wait for the first commit everywhere, then time rounds_target more.
     await asyncio.gather(*[q.get() for q in commits])
+    if emitter is not None:
+        # Stream from the measurement anchor, not process start: the N^2
+        # dial-in boot phase would otherwise dominate the stream with
+        # zero-progress windows and boot-skew timeouts, and SLO verdicts
+        # must judge the measured regime (boot counters still appear —
+        # cumulatively — in the first snapshot's totals, just never as a
+        # window delta).
+        emitter.emit()
+        emitter.spawn()
     registry = telemetry.get_registry()
     warmup = registry.snapshot()["counters"] if profile else None
     t0 = time.perf_counter()
@@ -328,8 +342,17 @@ def main() -> None:
         "--telemetry",
         metavar="PATH",
         help="protocol mode: enable the telemetry plane and stream "
-        "JSON-lines snapshots to PATH (final snapshot at shutdown; "
-        "interval via HOTSTUFF_TELEMETRY_INTERVAL)",
+        "JSON-lines snapshots + cross-node trace events to PATH (final "
+        "snapshot at shutdown; interval via HOTSTUFF_TELEMETRY_INTERVAL)",
+    )
+    p.add_argument(
+        "--slo",
+        nargs="?",
+        const="default",
+        metavar="SPEC.json",
+        help="with --telemetry: evaluate SLOs over the emitted snapshot "
+        "stream after the run (default spec set, or a JSON spec file) and "
+        "exit nonzero on violation",
     )
     p.add_argument("--output", help="directory to append the result file to")
     args = p.parse_args()
@@ -419,6 +442,32 @@ def main() -> None:
             out.write(line + "\n")
             for pl in profile_lines:
                 out.write(pl + "\n")
+
+    if args.slo:
+        if not args.telemetry:
+            print("--slo requires --telemetry PATH", file=sys.stderr)
+            sys.exit(2)
+        import json
+
+        from benchmark.logs import read_telemetry_stream
+        from hotstuff_tpu.telemetry import slo as slo_mod
+
+        specs = (
+            slo_mod.default_slos()
+            if args.slo == "default"
+            else slo_mod.load_specs(args.slo)
+        )
+        verdict = slo_mod.evaluate(
+            read_telemetry_stream(args.telemetry),
+            specs,
+            window_s=float(os.environ.get("HOTSTUFF_SLO_WINDOW_S", "30")),
+            source=args.telemetry,
+        )
+        print(json.dumps(verdict, sort_keys=True))
+        if not verdict["ok"]:
+            print("SLO verdict: FAILED", file=sys.stderr)
+            sys.exit(3)
+        print("SLO verdict: ok")
 
 
 if __name__ == "__main__":

@@ -81,11 +81,34 @@ def run_plane(args) -> dict:
     already-imported transport) and return {scenario: verdict}."""
     from hotstuff_tpu import telemetry
     from hotstuff_tpu.faultline import run_scenario
+    from hotstuff_tpu.telemetry import slo as slo_mod
 
     telemetry.enable()
+    # Chaos-appropriate SLOs evaluated on each run's final cumulative
+    # snapshot: round latency p99 (clean rounds only — faulted rounds
+    # have their own histogram) and the whole-run view-change rate.
+    # Thresholds are deliberately loose: the matrix's hard gate stays the
+    # invariant checker; the SLO section quantifies degradation.
+    chaos_specs = [
+        slo_mod.SloSpec(
+            "p99_round_commit_ms", "quantile",
+            "consensus.span.propose_to_commit_ms", q=0.99, max=15_000.0,
+        ),
+        slo_mod.SloSpec(
+            "timeouts_per_round", "ratio",
+            "consensus.timeouts_fired", per="consensus.rounds_advanced",
+            max=2.0,
+        ),
+    ]
     out: dict[str, dict] = {}
     base = args.base_port
     for scenario in build_scenarios(args.nodes, args.duration):
+        import time as _time
+
+        # Window the registry around THIS scenario: the process registry
+        # is cumulative across the matrix's scenarios, and each verdict
+        # must judge only its own run.
+        before = dict(telemetry.get_registry().snapshot(), ts=_time.time())
         result = asyncio.run(
             run_scenario(
                 scenario,
@@ -95,8 +118,13 @@ def run_plane(args) -> dict:
                 recovery_timeout_s=90.0,
             )
         )
+        after = dict(result["telemetry"], ts=_time.time())
         base += args.nodes + 16
         verdict = result["verdict"]
+        verdict["slo"] = slo_mod.evaluate(
+            [before, after], chaos_specs, source=scenario.name
+        )
+        verdict["flight_record"] = result.get("flight_record")
         out[scenario.name] = verdict
         status = (
             "ok"

@@ -80,7 +80,24 @@ class LocalBench:
         self._node_cmds: dict[int, tuple[list, str]] = {}  # i -> (cmd, log)
 
     def _cleanup(self) -> None:
-        for p in [*self._procs, *self._node_procs.values()]:
+        # SIGTERM first: nodes flush their final telemetry snapshot +
+        # trace tail from the signal handler (telemetry.arm_shutdown_flush)
+        # — without this the last interval of every stream was lost.
+        # SIGKILL after a short grace bounds the teardown; a node that
+        # missed the window just loses its final line (the lenient stream
+        # reader tolerates a truncated tail).
+        procs = [*self._procs, *self._node_procs.values()]
+        for p in procs:
+            if p.poll() is None:
+                p.send_signal(signal.SIGTERM)
+        deadline = time.monotonic() + 5.0
+        for p in procs:
+            if p.poll() is None:
+                try:
+                    p.wait(timeout=max(0.1, deadline - time.monotonic()))
+                except subprocess.TimeoutExpired:
+                    pass
+        for p in procs:
             if p.poll() is None:
                 p.send_signal(signal.SIGKILL)
         self._procs.clear()
@@ -158,9 +175,10 @@ class LocalBench:
             env["HOTSTUFF_FAULTLINE"] = os.path.abspath(self.chaos)
             self.telemetry = True
         if self.telemetry:
-            # Nodes stream telemetry-<name>.jsonl next to their logs. A
-            # short interval keeps the stream's tail close to the SIGKILL
-            # teardown (nodes never get to write a final snapshot here).
+            # Nodes stream telemetry-<name>.jsonl next to their logs; the
+            # SIGTERM-first teardown lets each node's signal handler flush
+            # its final snapshot + trace tail. A short interval still
+            # bounds the loss for nodes the chaos supervisor SIGKILLs.
             env["HOTSTUFF_TELEMETRY_DIR"] = logs_dir
             env.setdefault("HOTSTUFF_TELEMETRY_INTERVAL", "1")
 

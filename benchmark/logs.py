@@ -34,7 +34,12 @@ from datetime import datetime
 from re import findall, search
 from statistics import mean
 
-from hotstuff_tpu.telemetry import validate_snapshot
+from hotstuff_tpu.telemetry import (
+    SCHEMA as SNAPSHOT_SCHEMA,
+    TRACE_SCHEMA,
+    validate_snapshot,
+    validate_trace_record,
+)
 
 
 class ParseError(Exception):
@@ -236,24 +241,72 @@ class LogParser:
 # ---------------------------------------------------------------------------
 
 
-def read_telemetry_stream(path: str) -> list[dict]:
-    """Parse one JSON-lines snapshot file; skips blank lines, raises
-    ParseError on malformed JSON or schema-invalid snapshots."""
-    snapshots = []
+class StreamRecords:
+    """One parsed telemetry stream, by record schema.
+
+    ``snapshots`` are the ``hotstuff-telemetry-v1`` lines, ``traces`` the
+    interleaved ``hotstuff-trace-v1`` lines, ``skipped`` counts lines
+    that could not be used: a truncated FINAL line (a node crashed or was
+    SIGKILLed mid-write — expected under chaos, never fatal) and lines of
+    unknown schema (forward compatibility). Malformed JSON anywhere but
+    the last line still raises — mid-file corruption is a real bug, not
+    crash fallout."""
+
+    __slots__ = ("snapshots", "traces", "skipped")
+
+    def __init__(self) -> None:
+        self.snapshots: list[dict] = []
+        self.traces: list[dict] = []
+        self.skipped = 0
+
+
+def read_stream_records(path: str) -> StreamRecords:
     with open(path) as f:
-        for lineno, line in enumerate(f, 1):
-            line = line.strip()
-            if not line:
+        lines = [
+            (i, line.strip()) for i, line in enumerate(f, 1) if line.strip()
+        ]
+    records = StreamRecords()
+    for pos, (lineno, line) in enumerate(lines):
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError as e:
+            if pos == len(lines) - 1:
+                # Truncated final line: the writer died mid-append.
+                records.skipped += 1
                 continue
-            try:
-                obj = json.loads(line)
-            except json.JSONDecodeError as e:
-                raise ParseError(f"{path}:{lineno}: bad JSON: {e}") from e
+            raise ParseError(f"{path}:{lineno}: bad JSON: {e}") from e
+        schema = obj.get("schema") if isinstance(obj, dict) else None
+        if schema == SNAPSHOT_SCHEMA:
             problems = validate_snapshot(obj)
             if problems:
                 raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
-            snapshots.append(obj)
-    return snapshots
+            records.snapshots.append(obj)
+        elif schema == TRACE_SCHEMA:
+            problems = validate_trace_record(obj)
+            if problems:
+                raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
+            records.traces.append(obj)
+        else:
+            records.skipped += 1
+    return records
+
+
+class SnapshotStream(list):
+    """A list of snapshots that remembers how many lines were skipped
+    (kept a list subclass so existing callers stay source-compatible)."""
+
+    skipped = 0
+
+
+def read_telemetry_stream(path: str) -> SnapshotStream:
+    """Parse one JSON-lines stream; returns the snapshot lines (trace
+    lines are separated out — use ``read_stream_records`` for those),
+    tolerating a truncated final line. Raises ParseError on mid-stream
+    corruption or schema-invalid records."""
+    records = read_stream_records(path)
+    stream = SnapshotStream(records.snapshots)
+    stream.skipped = records.skipped
+    return stream
 
 
 class TelemetryParser:
@@ -273,6 +326,11 @@ class TelemetryParser:
             raise ParseError("no telemetry snapshots")
         self.snapshots = finals
         self.tx_size = tx_size
+        # Lines the lenient reader had to drop (truncated final writes of
+        # crashed nodes); surfaced so measurements know their provenance.
+        self.skipped_lines = sum(
+            getattr(s, "skipped", 0) for s in streams
+        )
 
         def gauge(snap, name):
             return snap["gauges"].get(name)

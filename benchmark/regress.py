@@ -1,0 +1,251 @@
+"""Perf-regression gate: fresh telemetry-derived measurements vs the
+committed baseline corpus, with noise-aware thresholds.
+
+Two checks, each min-over-repeats (the CI-stable estimator — scheduler
+noise inflates individual runs, a real regression shifts the minimum):
+
+- **protocol**: one-process committee round rate at ``--nodes`` under
+  the CURRENT backend/transport selection, compared against the best
+  committed ``results/committee-protocol-*.txt`` row with the same
+  (committee, backend, transport) key. The run streams telemetry and the
+  artifact records the registry-derived context (rounds advanced, QCs
+  formed, votes batched) alongside the wall number, so a regression
+  comes with its first diagnostic attached.
+- **crypto**: CPU batch-verify µs/sig at ``--sigs`` (the committed
+  BENCH_r0*.json shape: RLC + MSM through the native engine), compared
+  against the best committed ``cpu_batch_us``.
+
+A check fails when ``fresh_min > baseline_min * (1 + tolerance)``.
+``--tolerance`` defaults to 0.5: the committed corpus was measured on an
+idle box, CI shares cores — the gate catches the silent 2× rots, not 5%
+drift. Exit 0 green / 1 regression / 2 usage error.
+
+    python -m benchmark.regress --output results
+    HOTSTUFF_NET=native HOTSTUFF_CRYPTO_BACKEND=cpu-batched \
+        python -m benchmark.regress --nodes 100 --tolerance 0.35
+"""
+
+from __future__ import annotations
+
+import argparse
+import asyncio
+import glob
+import json
+import os
+import re
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+REGRESS_SCHEMA = "hotstuff-regress-v1"
+
+_PROTOCOL_LINE = re.compile(
+    r"committee=(\d+) .*mode=protocol.*backend=(\S+?)"
+    r"(?: transport=(\w+))?: ([\d.]+) ms/round"
+)
+
+
+def load_protocol_baselines(results_dir: str) -> list[dict]:
+    """Every committed protocol row: {nodes, backend, transport, ms}."""
+    rows: list[dict] = []
+    for fn in sorted(
+        glob.glob(os.path.join(results_dir, "committee-protocol-*.txt"))
+    ):
+        with open(fn) as f:
+            for line in f:
+                m = _PROTOCOL_LINE.search(line)
+                if m:
+                    rows.append(
+                        {
+                            "nodes": int(m.group(1)),
+                            "backend": m.group(2),
+                            "transport": m.group(3),  # None on old rows
+                            "ms_per_round": float(m.group(4)),
+                            "source": os.path.basename(fn),
+                        }
+                    )
+    return rows
+
+
+def best_protocol_baseline(
+    rows: list[dict], nodes: int, backend: str, transport: str
+) -> dict | None:
+    """Best committed row for this config. Rows predating the transport
+    tag match any transport (they were measured before the tag existed —
+    better a loose baseline than none)."""
+    matches = [
+        r
+        for r in rows
+        if r["nodes"] == nodes
+        and r["backend"] == backend
+        and r["transport"] in (transport, None)
+    ]
+    exact = [r for r in matches if r["transport"] == transport]
+    pool = exact or matches
+    return min(pool, key=lambda r: r["ms_per_round"]) if pool else None
+
+
+def load_crypto_baseline(repo_root: str) -> dict | None:
+    """Best committed CPU batch µs/sig across the BENCH_r0*.json rounds."""
+    best = None
+    for fn in sorted(glob.glob(os.path.join(repo_root, "BENCH_r0*.json"))):
+        try:
+            with open(fn) as f:
+                parsed = json.load(f).get("parsed") or {}
+        except (OSError, json.JSONDecodeError):
+            continue
+        us = parsed.get("cpu_batch_us")
+        if us is None:
+            continue
+        if best is None or us < best["cpu_batch_us"]:
+            best = {"cpu_batch_us": us, "source": os.path.basename(fn)}
+    return best
+
+
+def measure_protocol(nodes: int, rounds: int, repeats: int, base_port: int):
+    """(min ms/round, telemetry context) for the current stack."""
+    from benchmark.committee_scale import run_committee
+    from hotstuff_tpu import telemetry
+
+    telemetry.enable()
+    registry = telemetry.get_registry()
+    best = float("inf")
+    port = base_port
+    before = registry.snapshot()["counters"]
+    for _ in range(repeats):
+        per_round, _ = asyncio.run(
+            run_committee(nodes, rounds, port, timeout_delay=30_000)
+        )
+        best = min(best, per_round)
+        port += 2 * nodes
+    deltas = telemetry.diff_counters(before, registry.snapshot()["counters"])
+    context = {
+        k: v
+        for k, v in deltas.items()
+        if k in (
+            "consensus.rounds_advanced",
+            "consensus.qcs_formed",
+            "consensus.votes_received",
+            "consensus.blocks_committed",
+            "consensus.span.evicted_rounds",
+        )
+    }
+    return best * 1e3, context
+
+
+def measure_crypto(sigs: int, repeats: int) -> float:
+    """Min µs/sig of the CPU batch verify at the committed bench shape."""
+    import bench as headline_bench
+
+    msgs, pubs, sigs_ = headline_bench.make_batch(sigs)
+    best = float("inf")
+    for _ in range(repeats):
+        best = min(best, headline_bench.bench_cpu_batch(msgs, pubs, sigs_))
+    return best / sigs * 1e6
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=40)
+    p.add_argument("--rounds", type=int, default=12)
+    p.add_argument("--repeats", type=int, default=2)
+    p.add_argument("--sigs", type=int, default=1343)
+    p.add_argument(
+        "--tolerance",
+        type=float,
+        default=float(os.environ.get("HOTSTUFF_REGRESS_TOLERANCE", "0.5")),
+        help="allowed relative slowdown vs baseline (0.5 = +50%%)",
+    )
+    p.add_argument("--base-port", type=int, default=25000)
+    p.add_argument("--skip-protocol", action="store_true")
+    p.add_argument("--skip-crypto", action="store_true")
+    p.add_argument("--output", help="directory for the JSON artifact")
+    args = p.parse_args()
+
+    if args.skip_protocol and args.skip_crypto:
+        print("nothing to check", file=sys.stderr)
+        sys.exit(2)
+
+    checks: list[dict] = []
+
+    if not args.skip_protocol:
+        os.environ.setdefault("HOTSTUFF_CRYPTO_WORKERS", "32")
+        from hotstuff_tpu import network as _network
+        from hotstuff_tpu.crypto import get_backend
+
+        backend = get_backend().name
+        transport = (
+            "native" if "Native" in _network.Receiver.__name__ else "asyncio"
+        )
+        rows = load_protocol_baselines(os.path.join(REPO_ROOT, "results"))
+        baseline = best_protocol_baseline(rows, args.nodes, backend, transport)
+        fresh_ms, context = measure_protocol(
+            args.nodes, args.rounds, args.repeats, args.base_port
+        )
+        check = {
+            "metric": f"protocol_ms_per_round_n{args.nodes}",
+            "backend": backend,
+            "transport": transport,
+            "fresh": round(fresh_ms, 1),
+            "telemetry": context,
+        }
+        if baseline is None:
+            check.update(status="no-baseline", ok=True)
+        else:
+            limit = baseline["ms_per_round"] * (1 + args.tolerance)
+            check.update(
+                status="compared",
+                baseline=baseline["ms_per_round"],
+                baseline_source=baseline["source"],
+                limit=round(limit, 1),
+                ratio=round(fresh_ms / baseline["ms_per_round"], 3),
+                ok=fresh_ms <= limit,
+            )
+        checks.append(check)
+
+    if not args.skip_crypto:
+        baseline = load_crypto_baseline(REPO_ROOT)
+        fresh_us = measure_crypto(args.sigs, max(2, args.repeats))
+        check = {
+            "metric": f"crypto_cpu_batch_us_per_sig_{args.sigs}sigs",
+            "fresh": round(fresh_us, 2),
+        }
+        if baseline is None:
+            check.update(status="no-baseline", ok=True)
+        else:
+            limit = baseline["cpu_batch_us"] * (1 + args.tolerance)
+            check.update(
+                status="compared",
+                baseline=baseline["cpu_batch_us"],
+                baseline_source=baseline["source"],
+                limit=round(limit, 2),
+                ratio=round(fresh_us / baseline["cpu_batch_us"], 3),
+                ok=fresh_us <= limit,
+            )
+        checks.append(check)
+
+    ok = all(c["ok"] for c in checks)
+    report = {
+        "schema": REGRESS_SCHEMA,
+        "ok": ok,
+        "tolerance": args.tolerance,
+        "ts": time.time(),
+        "checks": checks,
+    }
+    print(json.dumps(report, indent=2, sort_keys=True))
+    if args.output:
+        os.makedirs(args.output, exist_ok=True)
+        path = os.path.join(args.output, "regress-gate.json")
+        with open(path, "w") as f:
+            json.dump(report, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"artifact written to {path}")
+    print(f"regression gate: {'GREEN' if ok else 'RED'}")
+    if not ok:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

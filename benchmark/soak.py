@@ -1,0 +1,193 @@
+"""SLO-gated soak harness: sustained load under faultline chaos, judged
+by machine verdicts instead of eyeballed summaries.
+
+Drives the multi-process local bench (real node processes + load
+clients) for ``--duration`` seconds with a seeded faultline chaos
+scenario armed and telemetry streaming per node, then judges THREE ways
+and passes only if all agree:
+
+1. the faultline **invariant checker** (safety: no conflicting commits;
+   liveness: post-heal commit growth) — correctness under chaos;
+2. the **SLO engine** over every node's snapshot stream in sliding
+   windows (p99 commit latency, ms/round, mempool queue depth,
+   timeout/view-change rate) — sustained service quality, with a bounded
+   tolerated fraction of degraded windows while faults are open;
+3. the **regex log parse** (tracebacks in any log fail the run).
+
+The verdict (one JSON artifact) is the machine contract ROADMAP item 3
+asks for: long runs gated on telemetry SLOs. Thresholds and the chaos
+seed are CLI knobs so CI smokes (60 s) and overnight soaks share this
+entry point.
+
+    python -m benchmark.soak --nodes 4 --rate 500 --duration 60 \
+        --chaos-seed 7 --output results
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from benchmark.local import LocalBench  # noqa: E402
+from benchmark.logs import ParseError, TelemetryParser, read_telemetry_stream  # noqa: E402
+from hotstuff_tpu.telemetry import slo as slo_mod  # noqa: E402
+
+SOAK_SCHEMA = "hotstuff-soak-verdict-v1"
+
+
+def run_soak(args) -> dict:
+    work_dir = os.path.abspath(args.work_dir)
+    chaos_path = None
+    if args.chaos_seed is not None:
+        from hotstuff_tpu.faultline import chaos_scenario
+
+        scenario = chaos_scenario(
+            args.chaos_seed, duration_s=float(args.duration)
+        )
+        # NOT inside work_dir: LocalBench.run() wipes that tree.
+        chaos_path = work_dir.rstrip("/") + "-soak-scenario.json"
+        scenario.save(chaos_path)
+
+    bench = LocalBench(
+        nodes=args.nodes,
+        rate=args.rate,
+        tx_size=args.tx_size,
+        duration=args.duration,
+        base_port=args.base_port,
+        timeout_delay=args.timeout,
+        work_dir=args.work_dir,
+        telemetry=True,
+        chaos=chaos_path,
+    )
+    parse_error = None
+    summary = None
+    try:
+        parser = bench.run()
+        summary = parser.result()
+    except ParseError as e:
+        parse_error = str(e)
+
+    logs_dir = os.path.join(work_dir, "logs")
+    streams: dict[str, list[dict]] = {}
+    skipped = 0
+    for fn in sorted(glob.glob(os.path.join(logs_dir, "telemetry-*.jsonl"))):
+        stream = read_telemetry_stream(fn)
+        skipped += stream.skipped
+        streams[os.path.basename(fn)] = list(stream)
+
+    specs = (
+        slo_mod.load_specs(args.slo_spec)
+        if args.slo_spec
+        else slo_mod.default_slos(
+            p99_commit_latency_ms=args.p99_commit_ms,
+            ms_per_round=args.ms_per_round,
+            mempool_queue_depth=args.queue_depth,
+            timeouts_per_round=args.timeouts_per_round,
+            allow_violation_fraction=args.allow_violation_fraction,
+        )
+    )
+    slo_verdict = slo_mod.evaluate_streams(
+        streams, specs, window_s=args.window
+    )
+
+    chaos_ok = True
+    if bench.chaos_verdict is not None:
+        chaos_ok = (
+            bench.chaos_verdict["safety"]["ok"]
+            and bench.chaos_verdict["liveness"]["recovered"]
+        )
+
+    telemetry_summary = None
+    try:
+        tele = TelemetryParser.process(logs_dir, tx_size=args.tx_size)
+        tps, bps, duration = tele.consensus_throughput()
+        telemetry_summary = {
+            "consensus_tps": round(tps),
+            "consensus_bps": round(bps),
+            "consensus_latency_ms": round(tele.consensus_latency_ms()),
+            "measured_window_s": round(duration, 1),
+            "skipped_stream_lines": tele.skipped_lines,
+        }
+    except ParseError:
+        pass
+
+    ok = slo_verdict["ok"] and chaos_ok and parse_error is None
+    return {
+        "schema": SOAK_SCHEMA,
+        "ok": ok,
+        "config": {
+            "nodes": args.nodes,
+            "rate": args.rate,
+            "tx_size": args.tx_size,
+            "duration_s": args.duration,
+            "chaos_seed": args.chaos_seed,
+            "slo_window_s": args.window,
+        },
+        "slo": slo_verdict,
+        "chaos": bench.chaos_verdict,
+        "telemetry": telemetry_summary,
+        "parse_error": parse_error,
+        "skipped_stream_lines": skipped,
+        "summary": summary,
+    }
+
+
+def main() -> None:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--nodes", type=int, default=4)
+    p.add_argument("--rate", type=int, default=500, help="total input tx/s")
+    p.add_argument("--tx-size", type=int, default=512)
+    p.add_argument("--duration", type=int, default=60, help="soak seconds")
+    p.add_argument("--timeout", type=int, default=1_000, help="consensus ms")
+    p.add_argument("--base-port", type=int, default=9400)
+    p.add_argument("--work-dir", default=".soak")
+    p.add_argument(
+        "--chaos-seed", type=int, default=None,
+        help="arm a seeded faultline chaos storm for the whole run",
+    )
+    p.add_argument(
+        "--window", type=float, default=15.0, help="SLO sliding window (s)"
+    )
+    p.add_argument("--slo-spec", help="JSON SLO spec file (overrides knobs)")
+    p.add_argument("--p99-commit-ms", type=float, default=5_000.0)
+    p.add_argument("--ms-per-round", type=float, default=2_000.0)
+    p.add_argument("--queue-depth", type=float, default=50_000.0)
+    p.add_argument("--timeouts-per-round", type=float, default=1.0)
+    p.add_argument(
+        "--allow-violation-fraction", type=float, default=0.34,
+        help="tolerated fraction of degraded windows per SLO (chaos "
+        "scenarios legitimately stall while a partition is open)",
+    )
+    p.add_argument("--output", help="directory for the verdict artifact")
+    args = p.parse_args()
+
+    verdict = run_soak(args)
+    print(json.dumps({k: v for k, v in verdict.items() if k != "summary"},
+                     indent=2, sort_keys=True))
+    if verdict["summary"]:
+        print(verdict["summary"])
+    if args.output:
+        os.makedirs(args.output, exist_ok=True)
+        tag = (
+            f"chaos{args.chaos_seed}" if args.chaos_seed is not None else "clean"
+        )
+        path = os.path.join(
+            args.output,
+            f"soak-slo-n{args.nodes}-{args.duration}s-{tag}.json",
+        )
+        with open(path, "w") as f:
+            json.dump(verdict, f, indent=2, sort_keys=True)
+            f.write("\n")
+        print(f"verdict written to {path}")
+    print(f"soak verdict: {'PASS' if verdict['ok'] else 'FAIL'}")
+    if not verdict["ok"]:
+        sys.exit(1)
+
+
+if __name__ == "__main__":
+    main()

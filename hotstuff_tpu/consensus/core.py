@@ -151,7 +151,10 @@ class Core:
         self._m_blocks = telemetry.counter("consensus.blocks_committed")
         self._g_round = telemetry.gauge("consensus.round")
         self._g_committed_round = telemetry.gauge("consensus.last_committed_round")
-        self._trace = telemetry.round_trace()
+        # The node label keys this engine's events in the cross-node
+        # trace stream (in-process committees share one ring buffer);
+        # the 16-char base64 prefix is unique within any real committee.
+        self._trace = telemetry.round_trace(node=repr(name))
         # This node's verified-certificate memory: rebroadcast QCs/TCs
         # (every view-change timeout carries the same high_qc; every
         # TC-former broadcasts the TC; timers retransmit) verify once
@@ -669,6 +672,8 @@ class Core:
         vote = await self.make_vote(block)
         if vote is not None:
             log.debug("Created %r", vote)
+            if self._trace is not None:
+                self._trace.mark_vote_send(block.round)
             next_leader = self.leader_elector.get_leader(self.round + 1)
             if next_leader == self.name:
                 await self.handle_vote(vote)
@@ -716,6 +721,12 @@ class Core:
         await verify_off_loop(
             block.verify, self.committee, self._cert_cache, n_sigs=n_sigs
         )
+        if self._trace is not None:
+            # receive→verified is the crypto-plane edge of the cross-node
+            # timeline; the assembler attributes it separately from the
+            # decode/queue edge (propose_send→propose) and the vote edge
+            # (verified→vote_send).
+            self._trace.mark_verified(block.round)
         await self.process_qc(block.qc)
         if block.tc is not None:
             await self.advance_round(block.tc.round, via_tc=True)

@@ -97,6 +97,10 @@ class Proposer:
                     # ``proposer.rs:76-80``).
                     log.info("Created %s -> %s", block, d)
         log.debug("Broadcasting %r", block)
+        # Cross-node trace anchor: the leader's broadcast instant is t=0
+        # of the round's causal timeline (the propose_send→propose edge
+        # at each replica is wire + receiver decode + core queue wait).
+        telemetry.trace_event(repr(self.name), round_, "propose_send")
 
         serialized = encode_propose(block)
         names_addresses = self.committee.broadcast_addresses(self.name)

@@ -309,7 +309,15 @@ class ScenarioRun:
             min_recovery_commits=self.min_recovery_commits,
             injections=self.plane.injection_summary(),
         )
+        flight_path = None
+        if not (verdict["safety"]["ok"] and verdict["liveness"]["recovered"]):
+            # Checker failure => actionable postmortem, not just a
+            # verdict: dump the flight recorder (the last ring of
+            # protocol trace events across every in-process engine, plus
+            # the registry state and the fault injection summary).
+            flight_path = _dump_flight_for(self, verdict)
         return {
+            "flight_record": flight_path,
             "verdict": verdict,
             "trace": self.schedule.trace(),
             "telemetry": telemetry.get_registry().snapshot(),
@@ -321,6 +329,33 @@ class ScenarioRun:
                 for name, recs in self.commits.items()
             },
         }
+
+
+def _dump_flight_for(run: "ScenarioRun", verdict: dict) -> str | None:
+    """Write the flight record for a failed run. Destination:
+    ``HOTSTUFF_FLIGHT_DIR`` when set, else the system temp dir (a
+    failing chaos TEST must not litter the working tree)."""
+    if not telemetry.enabled():
+        return None
+    import os
+    import tempfile
+
+    directory = os.environ.get("HOTSTUFF_FLIGHT_DIR", tempfile.gettempdir())
+    path = os.path.join(
+        directory,
+        f"flightrec-{run.scenario.name}-seed{run.scenario.seed}"
+        f"-n{run.n}.json",
+    )
+    return telemetry.dump_flight_record(
+        path,
+        "checker_failure",
+        telemetry.trace_buffer(),
+        telemetry.get_registry(),
+        extra={
+            "verdict": verdict,
+            "injections": run.plane.injection_summary(),
+        },
+    )
 
 
 async def run_scenario(scenario: Scenario, n: int, **kwargs) -> dict:

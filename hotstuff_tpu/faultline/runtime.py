@@ -192,6 +192,12 @@ class FaultPlane:
             len(self._partitions) + len(self._links)
             + sum(len(b) for b in self._behaviors.values())
         )
+        # Flight-recorder context: injected transitions interleave with
+        # the protocol events in the trace ring, so a postmortem shows
+        # WHAT the committee was doing when each fault landed.
+        telemetry.trace_event(
+            "faultline", 0, f"{'heal' if heal else 'inject'}:{kind}"
+        )
         log.info(
             "faultline %s %s %s (v=%.3fs)",
             "healed" if heal else "injected", kind, ev.params,

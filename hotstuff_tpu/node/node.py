@@ -134,7 +134,16 @@ class Node:
                 stream_path,
                 node=str(secret.name),
                 interval_s=telemetry.env_interval_s(),
+                trace=telemetry.trace_buffer(),
             ).spawn()
+            # Unclean teardown (SIGTERM from the local bench, atexit)
+            # still flushes the final snapshot + trace tail and dumps the
+            # flight record — without this the last interval of every
+            # killed node's stream was lost.
+            telemetry.arm_shutdown_flush(
+                self.telemetry_emitter,
+                flight_path=telemetry.env_flight_path(str(secret.name)),
+            )
 
         log.info("Node %s successfully booted", secret.name)
         return self
@@ -182,6 +191,17 @@ class Node:
             self.mempool = None
         self.crashed = True
         telemetry.counter("faultline.injected.crashes").inc()
+        if telemetry.enabled() and self._boot is not None:
+            # Postmortem: the last ring of protocol events at the moment
+            # of the (injected) crash, plus the registry state.
+            flight_path = telemetry.env_flight_path(str(self._boot[0].name))
+            if flight_path is not None:
+                telemetry.dump_flight_record(
+                    flight_path,
+                    "node_crash",
+                    telemetry.trace_buffer(),
+                    telemetry.get_registry(),
+                )
         log.warning("Node crashed (supervised)")
 
     async def restart(self) -> "Node":

@@ -40,12 +40,14 @@ from .emitter import (
     DEFAULT_INTERVAL_S,
     SCHEMA,
     TelemetryEmitter,
+    arm_shutdown_flush,
     build_snapshot,
     validate_snapshot,
 )
 from .registry import (
     COUNT_BUCKETS,
     DURATION_MS_BUCKETS,
+    FINE_DURATION_MS_BUCKETS,
     SIZE_BYTES_BUCKETS,
     Counter,
     Gauge,
@@ -54,12 +56,23 @@ from .registry import (
     diff_counters,
 )
 from .spans import RoundTrace
+from .trace import (
+    FLIGHT_SCHEMA,
+    TRACE_SCHEMA,
+    TraceBuffer,
+    build_trace_record,
+    dump_flight_record,
+    validate_trace_record,
+)
 
 __all__ = [
     "COUNT_BUCKETS",
     "DURATION_MS_BUCKETS",
+    "FINE_DURATION_MS_BUCKETS",
     "SIZE_BYTES_BUCKETS",
     "SCHEMA",
+    "TRACE_SCHEMA",
+    "FLIGHT_SCHEMA",
     "DEFAULT_INTERVAL_S",
     "Counter",
     "Gauge",
@@ -70,9 +83,14 @@ __all__ = [
     "Registry",
     "RoundTrace",
     "TelemetryEmitter",
+    "TraceBuffer",
+    "arm_shutdown_flush",
     "build_snapshot",
+    "build_trace_record",
     "validate_snapshot",
+    "validate_trace_record",
     "diff_counters",
+    "dump_flight_record",
     "counter",
     "gauge",
     "histogram",
@@ -83,14 +101,18 @@ __all__ = [
     "enabled",
     "env_interval_s",
     "env_stream_path",
+    "env_flight_path",
     "record_created",
     "record_sealed",
     "record_commit",
     "round_trace",
+    "trace_buffer",
+    "trace_event",
     "reset_for_tests",
 ]
 
 _REGISTRY = Registry()
+_TRACE_BUFFER = TraceBuffer()
 _ENABLED = bool(
     os.environ.get("HOTSTUFF_TELEMETRY") or os.environ.get("HOTSTUFF_TELEMETRY_DIR")
 )
@@ -204,6 +226,20 @@ def env_stream_path(node: str = "") -> str | None:
     return None
 
 
+def env_flight_path(node: str = "") -> str | None:
+    """Where this process should dump flight records: HOTSTUFF_FLIGHT_DIR
+    explicitly, else next to the telemetry stream when one is configured,
+    else None (flight recording stays in-memory only)."""
+    safe = "".join(c if c.isalnum() else "-" for c in node) or str(os.getpid())
+    directory = os.environ.get("HOTSTUFF_FLIGHT_DIR")
+    if not directory:
+        stream = env_stream_path(node)
+        if stream is None:
+            return None
+        directory = os.path.dirname(os.path.abspath(stream))
+    return os.path.join(directory, f"flightrec-{safe}.json")
+
+
 # ---------------------------------------------------------------------------
 # Benchmark-interface tables (the regex contract, telemetry-side).
 #
@@ -276,16 +312,36 @@ def record_commit(digest: bytes, ts: float | None = None) -> None:
         _REGISTRY.counter("consensus.committed_bytes").inc(size)
 
 
-def round_trace() -> RoundTrace | None:
-    """A RoundTrace bound to the process registry, or None when disabled
-    (cores hold the None and skip marking entirely)."""
-    return RoundTrace(_REGISTRY) if _ENABLED else None
+def round_trace(node: str = "") -> RoundTrace | None:
+    """A RoundTrace bound to the process registry and the process trace
+    buffer, or None when disabled (cores hold the None and skip marking
+    entirely). ``node`` labels this core's events in the cross-node
+    trace stream — in-process committees share one buffer, so the label
+    is what keeps each engine's timeline separable."""
+    if not _ENABLED:
+        return None
+    return RoundTrace(_REGISTRY, node=node, events=_TRACE_BUFFER)
+
+
+def trace_buffer() -> TraceBuffer:
+    """The process trace ring (live even when disabled, so emitters and
+    the flight recorder can be wired up before/without enablement)."""
+    return _TRACE_BUFFER
+
+
+def trace_event(node: str, round_: int, stage: str) -> None:
+    """Record one protocol trace event into the process ring (no-op when
+    telemetry is disabled). For sites without a RoundTrace — the
+    proposer's broadcast mark, faultline injections."""
+    if _ENABLED:
+        _TRACE_BUFFER.record(node, round_, stage)
 
 
 def reset_for_tests() -> None:
-    """Clear registry, tables, and enablement (test isolation)."""
+    """Clear registry, tables, trace ring, and enablement (isolation)."""
     global _ENABLED
     _REGISTRY.reset()
+    _TRACE_BUFFER.clear()
     with _tables_lock:
         _proposed.clear()
         _sealed.clear()

@@ -6,6 +6,19 @@ histograms). Cumulative-not-delta means a reader needs only the LAST
 line of a stream — a crashed node's stream is still fully usable up to
 its final interval, and intermediate lines give time series for free.
 
+When a :class:`~.trace.TraceBuffer` is attached, each emit additionally
+appends one ``hotstuff-trace-v1`` line carrying the protocol trace
+events recorded since the previous emit (delta, not cumulative — events
+are large and append-only), interleaved with the snapshots in the same
+stream. ``benchmark/logs.py`` separates the two schemas when reading.
+
+Unclean shutdown: :func:`arm_shutdown_flush` registers SIGTERM and
+``atexit`` hooks that write the ``final: true`` snapshot (and trace
+tail, and optionally a flight record) even when the process never
+reaches its graceful ``shutdown()`` — the local bench's teardown and
+faultline's crash/restart harness both kill nodes, and without this the
+last interval of every stream was lost.
+
 ``benchmark/logs.py`` consumes these streams (``TelemetryParser``)
 alongside its regex path; the CI smoke lane validates them with
 ``validate_snapshot``.
@@ -14,10 +27,14 @@ alongside its regex path; the CI smoke lane validates them with
 from __future__ import annotations
 
 import asyncio
+import atexit
 import json
 import logging
 import os
+import signal
 import time
+
+from .trace import build_trace_record, dump_flight_record
 
 log = logging.getLogger("telemetry")
 
@@ -91,7 +108,9 @@ class TelemetryEmitter:
     """Appends one snapshot line to ``path`` every ``interval_s`` and a
     ``final`` one at shutdown. Each write is a single buffered
     write+flush of a complete line, so concurrent emitters appending to
-    the same file (in-process testbeds) interleave at line granularity."""
+    the same file (in-process testbeds) interleave at line granularity.
+    With ``trace`` attached, each emit also appends a trace line carrying
+    the protocol events recorded since the previous emit."""
 
     def __init__(
         self,
@@ -99,25 +118,42 @@ class TelemetryEmitter:
         path: str,
         node: str = "",
         interval_s: float = DEFAULT_INTERVAL_S,
+        trace=None,
     ) -> None:
         self.registry = registry
         self.path = path
         self.node = node
         self.interval_s = max(float(interval_s), 0.05)
+        self.trace = trace  # TraceBuffer or None
+        self._trace_seq = 0  # last trace event seq already streamed
         self._seq = 0
+        self._final_done = False
         self._task: asyncio.Task | None = None
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
 
     def emit(self, final: bool = False) -> dict:
+        if final:
+            if self._final_done:
+                # Already flushed (signal handler / atexit raced the
+                # graceful shutdown): final state is on disk, don't
+                # duplicate it.
+                return {}
+            self._final_done = True
         snapshot = build_snapshot(
             self.registry, node=self.node, seq=self._seq, final=final
         )
         self._seq += 1
-        line = json.dumps(snapshot, separators=(",", ":"))
+        lines = [json.dumps(snapshot, separators=(",", ":"))]
+        if self.trace is not None:
+            events = self.trace.events_since(self._trace_seq)
+            if events:
+                self._trace_seq = events[-1][0]
+                record = build_trace_record(self.trace, events, node=self.node)
+                lines.append(json.dumps(record, separators=(",", ":")))
         try:
             with open(self.path, "a") as f:
-                f.write(line + "\n")
+                f.write("\n".join(lines) + "\n")
         except OSError as e:  # telemetry must never kill the node
             log.error("cannot write telemetry snapshot to %s: %s", self.path, e)
         return snapshot
@@ -136,3 +172,48 @@ class TelemetryEmitter:
             self._task.cancel()
             self._task = None
         self.emit(final=True)
+
+
+def arm_shutdown_flush(
+    emitter: TelemetryEmitter, flight_path: str | None = None
+) -> None:
+    """Guarantee the ``final: true`` snapshot survives unclean teardown.
+
+    Registers an ``atexit`` hook and chains a SIGTERM handler: both flush
+    the final snapshot (idempotent — ``emit(final=True)`` runs at most
+    once per emitter) and, when ``flight_path`` is given, dump the flight
+    record. The SIGTERM handler then restores the previous disposition
+    and re-raises the signal so the process still dies with the expected
+    status — this instrumentation observes shutdown, it doesn't veto it.
+    SIGKILL remains unsurvivable by design; benches that want the final
+    interval send SIGTERM first (``benchmark/local.py`` does).
+    """
+
+    def _flush(reason: str) -> None:
+        try:
+            emitter.emit(final=True)
+            if flight_path is not None and emitter.trace is not None:
+                dump_flight_record(
+                    flight_path, reason, emitter.trace, emitter.registry
+                )
+        except Exception as e:  # noqa: BLE001 — shutdown paths never raise
+            log.error("telemetry shutdown flush failed: %s", e)
+
+    atexit.register(_flush, "atexit")
+
+    try:
+        previous = signal.getsignal(signal.SIGTERM)
+
+        def _on_sigterm(signum, frame):
+            _flush("sigterm")
+            if callable(previous):
+                previous(signum, frame)
+            else:
+                signal.signal(signal.SIGTERM, signal.SIG_DFL)
+                signal.raise_signal(signal.SIGTERM)
+
+        signal.signal(signal.SIGTERM, _on_sigterm)
+    except (ValueError, OSError):
+        # Not the main thread (in-process testbeds spawn emitters from
+        # worker contexts): the atexit hook still covers interpreter exit.
+        pass

@@ -36,6 +36,15 @@ DURATION_MS_BUCKETS = (
     0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 500,
     1_000, 2_500, 5_000, 10_000, 30_000,
 )
+# Fine-grained duration buckets: the crypto plane's per-signature regime
+# is 22-26 µs (0.022-0.026 ms) and native-path spans at small committees
+# sit under DURATION_MS_BUCKETS' 0.1 ms floor — both collapsed into one
+# bucket there. These edges resolve 1 µs .. 1 s; metrics pick their scale
+# per name (``Registry.histogram(name, buckets)``).
+FINE_DURATION_MS_BUCKETS = (
+    0.001, 0.0025, 0.005, 0.01, 0.025, 0.05,
+    0.1, 0.25, 0.5, 1, 2.5, 5, 10, 25, 50, 100, 250, 1_000,
+)
 SIZE_BYTES_BUCKETS = (
     64, 256, 1_024, 4_096, 16_384, 65_536, 262_144, 1_048_576, 16_777_216,
 )

@@ -1,0 +1,228 @@
+"""SLO engine tests: window arithmetic over cumulative snapshot streams
+(empty window, single snapshot, counter reset after restart), histogram
+quantiles, and verdict semantics."""
+
+from __future__ import annotations
+
+import pytest
+
+from hotstuff_tpu.telemetry import slo
+
+
+def _snap(ts, counters=None, histograms=None, gauges=None):
+    return {
+        "ts": ts,
+        "seq": int(ts),
+        "counters": counters or {},
+        "histograms": histograms or {},
+        "gauges": gauges or {},
+    }
+
+
+def _hist(le, counts):
+    return {
+        "le": list(le),
+        "counts": list(counts),
+        "sum": 0.0,
+        "count": sum(counts),
+    }
+
+
+# -- primitives --------------------------------------------------------------
+
+
+def test_counter_delta_and_reset():
+    b = _snap(0, {"c": 100})
+    a = _snap(10, {"c": 150})
+    assert slo.counter_delta(b, a, "c") == 50
+    # Restart mid-window: cumulative value went DOWN => counted from
+    # zero again, the delta is the after-value, never negative.
+    a_reset = _snap(10, {"c": 30})
+    assert slo.counter_delta(b, a_reset, "c") == 30
+    assert slo.counter_delta(None, a, "c") == 150
+    assert slo.counter_delta(b, _snap(10, {}), "c") == 0
+
+
+def test_histogram_delta_and_reset():
+    le = (1, 10, 100)
+    b = _snap(0, histograms={"h": _hist(le, [5, 3, 0, 0])})
+    a = _snap(10, histograms={"h": _hist(le, [8, 4, 1, 0])})
+    d = slo.histogram_delta(b, a, "h")
+    assert d["counts"] == [3, 1, 1, 0]
+    # Reset: any negative bucket falls back to the after-histogram.
+    a_reset = _snap(10, histograms={"h": _hist(le, [2, 0, 0, 0])})
+    d = slo.histogram_delta(b, a_reset, "h")
+    assert d["counts"] == [2, 0, 0, 0]
+    assert slo.histogram_delta(b, _snap(10), "h") is None
+
+
+def test_histogram_quantile_interpolation():
+    h = _hist((10, 20, 40), [0, 100, 0, 0])  # all mass in (10, 20]
+    assert slo.histogram_quantile(h, 0.5) == pytest.approx(15.0)
+    assert slo.histogram_quantile(h, 0.99) == pytest.approx(19.9)
+    # Overflow bucket resolves to the last edge (conservative).
+    h = _hist((10, 20), [0, 0, 5])
+    assert slo.histogram_quantile(h, 0.99) == 20
+    assert slo.histogram_quantile(_hist((10,), [0, 0]), 0.5) is None
+
+
+def test_windows_empty_single_and_sliding():
+    assert slo.windows([], 30.0) == []
+    s0 = _snap(0)
+    assert slo.windows([s0], 30.0) == [(None, s0)]  # cumulative-from-zero
+    snaps = [_snap(t) for t in (0, 10, 20, 30, 40)]
+    wins = slo.windows(snaps, 30.0)
+    assert len(wins) == 4  # one per snapshot past the first
+    # The last window spans [10, 40] (>= 30 s back), the second [0, 10]
+    # (clamped to the stream head during warm-up).
+    assert wins[-1][0]["ts"] == 10 and wins[-1][1]["ts"] == 40
+    assert wins[0][0]["ts"] == 0 and wins[0][1]["ts"] == 10
+
+
+# -- evaluation --------------------------------------------------------------
+
+
+def test_evaluate_empty_stream_fails_closed():
+    verdict = slo.evaluate([], slo.default_slos())
+    assert verdict["ok"] is False
+    assert verdict["reason"] == "no snapshots"
+
+
+def test_evaluate_single_snapshot_uses_cumulative_window():
+    snap = _snap(
+        100,
+        counters={"consensus.timeouts_fired": 1,
+                  "consensus.rounds_advanced": 100},
+    )
+    specs = [
+        slo.SloSpec(
+            "timeouts_per_round", "ratio", "consensus.timeouts_fired",
+            per="consensus.rounds_advanced", max=0.5,
+        )
+    ]
+    verdict = slo.evaluate([snap], specs)
+    assert verdict["ok"] is True
+    assert verdict["slos"][0]["windows"] == 1
+    assert verdict["slos"][0]["worst"] == pytest.approx(0.01)
+
+
+def test_evaluate_ms_per_round_flags_stall():
+    snaps = [
+        _snap(0, {"consensus.rounds_advanced": 10}),
+        _snap(10, {"consensus.rounds_advanced": 110}),  # 100 ms/round: ok
+        _snap(20, {"consensus.rounds_advanced": 110}),  # stall: inf
+    ]
+    specs = [
+        slo.SloSpec(
+            "ms_per_round", "ms_per_count",
+            "consensus.rounds_advanced", max=500.0,
+        )
+    ]
+    verdict = slo.evaluate(snaps, specs, window_s=5.0)
+    res = verdict["slos"][0]
+    assert res["windows"] == 2
+    assert res["violated_windows"] == 1
+    assert res["worst"] == "inf"
+    assert verdict["ok"] is False
+    # A bounded tolerated degradation fraction flips it green.
+    specs[0].allow_violation_fraction = 0.5
+    assert slo.evaluate(snaps, specs, window_s=5.0)["ok"] is True
+
+
+def test_evaluate_counter_reset_is_not_a_violation():
+    # A node restart resets the counter; the reset-aware delta keeps the
+    # window positive and the rate sane.
+    snaps = [
+        _snap(0, {"consensus.rounds_advanced": 500}),
+        _snap(10, {"consensus.rounds_advanced": 40}),  # restarted
+    ]
+    specs = [
+        slo.SloSpec(
+            "ms_per_round", "ms_per_count",
+            "consensus.rounds_advanced", max=500.0,
+        )
+    ]
+    verdict = slo.evaluate(snaps, specs, window_s=5.0)
+    assert verdict["ok"] is True
+    assert verdict["slos"][0]["worst"] == pytest.approx(250.0)
+
+
+def test_evaluate_quantile_and_gauge():
+    hist = _hist((100, 500, 1000), [90, 10, 0, 0])
+    snaps = [
+        _snap(0, histograms={"consensus.commit_latency_ms": _hist(
+            (100, 500, 1000), [0, 0, 0, 0])}),
+        _snap(
+            30,
+            histograms={"consensus.commit_latency_ms": hist},
+            gauges={"mempool.tx_queue_depth": 120.0},
+        ),
+    ]
+    specs = [
+        slo.SloSpec(
+            "p99", "quantile", "consensus.commit_latency_ms",
+            q=0.99, max=450.0,
+        ),
+        slo.SloSpec(
+            "queue", "gauge_max", "mempool.tx_queue_depth", max=100.0,
+        ),
+    ]
+    verdict = slo.evaluate(snaps, specs, window_s=10.0)
+    by_name = {r["spec"]["name"]: r for r in verdict["slos"]}
+    # 90 of 100 observations ≤ 100 ms, the rest in (100, 500]: the
+    # interpolated p99 is 100 + 400*(9/10) = 460 ms > the 450 budget.
+    assert by_name["p99"]["ok"] is False
+    assert by_name["p99"]["worst"] == pytest.approx(460.0)
+    assert by_name["queue"]["ok"] is False
+    assert by_name["queue"]["worst"] == 120.0
+
+
+def test_metric_absent_is_not_a_violation():
+    snaps = [_snap(0), _snap(30)]
+    verdict = slo.evaluate(snaps, slo.default_slos(), window_s=10.0)
+    # No metric ever appeared: every spec reports zero windows and the
+    # verdict stays green (absence of a plane ≠ violation) — but the
+    # stream itself carried windows, so ok is True.
+    assert verdict["ok"] is True
+    assert all(r["windows"] == 0 for r in verdict["slos"])
+
+
+def test_evaluate_streams_aggregates_per_node():
+    good = [
+        _snap(0, {"consensus.rounds_advanced": 0}),
+        _snap(10, {"consensus.rounds_advanced": 100}),
+    ]
+    stalled = [
+        _snap(0, {"consensus.rounds_advanced": 0}),
+        _snap(10, {"consensus.rounds_advanced": 0}),
+    ]
+    specs = [
+        slo.SloSpec(
+            "ms_per_round", "ms_per_count",
+            "consensus.rounds_advanced", max=500.0,
+        )
+    ]
+    verdict = slo.evaluate_streams(
+        {"n0": good, "n1": stalled}, specs, window_s=5.0
+    )
+    assert verdict["ok"] is False  # a wedged straggler fails the cluster
+    assert verdict["nodes"]["n0"]["ok"] is True
+    assert verdict["nodes"]["n1"]["ok"] is False
+
+
+def test_spec_validation_and_io(tmp_path):
+    with pytest.raises(ValueError):
+        slo.SloSpec("x", "nope", "m", max=1)
+    with pytest.raises(ValueError):
+        slo.SloSpec("x", "quantile", "m", q=1.5, max=1)
+    with pytest.raises(ValueError):
+        slo.SloSpec("x", "ratio", "m", max=1)  # missing per
+    with pytest.raises(ValueError):
+        slo.SloSpec("x", "rate", "m")  # no threshold
+    import json
+
+    specs = slo.default_slos()
+    path = tmp_path / "slos.json"
+    path.write_text(json.dumps([s.to_dict() for s in specs]))
+    loaded = slo.load_specs(str(path))
+    assert [s.to_dict() for s in loaded] == [s.to_dict() for s in specs]

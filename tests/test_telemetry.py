@@ -168,10 +168,91 @@ def test_validate_snapshot_rejects_malformed():
 def test_read_telemetry_stream_raises_on_garbage(tmp_path):
     from benchmark.logs import ParseError, read_telemetry_stream
 
+    # Mid-stream corruption still raises (a real bug, not crash fallout):
+    # the garbage line is followed by a valid snapshot.
+    good = json.dumps(build_snapshot(Registry(), node="n"))
     path = tmp_path / "telemetry-bad.jsonl"
-    path.write_text("not json\n")
+    path.write_text(f"not json\n{good}\n")
     with pytest.raises(ParseError):
         read_telemetry_stream(str(path))
+
+
+def test_read_telemetry_stream_tolerates_truncated_final_line(tmp_path):
+    """A node SIGKILLed mid-write leaves a truncated last line; the
+    reader must keep the valid prefix and count the loss."""
+    from benchmark.logs import TelemetryParser, read_telemetry_stream
+
+    r = Registry()
+    r.counter("c.events").inc(3)
+    path = tmp_path / "telemetry-crash.jsonl"
+    emitter = TelemetryEmitter(r, str(path), node="crash")
+    emitter.emit()
+    emitter.emit()
+    with open(path, "a") as f:
+        f.write('{"schema": "hotstuff-telemetry-v1", "node": "crash", "coun')
+    snaps = read_telemetry_stream(str(path))
+    assert len(snaps) == 2
+    assert snaps.skipped == 1
+    parser = TelemetryParser([list(snaps)])
+    assert parser.counter_total("c.events") == 3
+    parser = TelemetryParser([snaps])
+    assert parser.skipped_lines == 1
+
+
+def test_stream_interleaves_trace_records(tmp_path):
+    """Trace lines ride the same stream; the snapshot reader separates
+    them and read_stream_records hands both out."""
+    from benchmark.logs import read_stream_records, read_telemetry_stream
+
+    telemetry.enable()
+    r = telemetry.get_registry()
+    buf = telemetry.trace_buffer()
+    path = tmp_path / "telemetry-t.jsonl"
+    emitter = TelemetryEmitter(r, str(path), node="t", trace=buf)
+    telemetry.trace_event("n0", 1, "propose")
+    telemetry.trace_event("n0", 1, "commit")
+    emitter.emit()
+    telemetry.trace_event("n0", 2, "propose")
+    emitter.emit(final=True)
+
+    records = read_stream_records(str(path))
+    assert len(records.snapshots) == 2
+    assert len(records.traces) == 2
+    # Delta semantics: each trace line carries only NEW events.
+    assert len(records.traces[0]["events"]) == 2
+    assert len(records.traces[1]["events"]) == 1
+    assert records.traces[0]["anchor"]["wall"] > 0
+    snaps = read_telemetry_stream(str(path))  # trace lines separated out
+    assert len(snaps) == 2 and snaps.skipped == 0
+
+
+def test_emitter_final_flush_is_idempotent(tmp_path):
+    """arm_shutdown_flush's atexit/SIGTERM paths and a graceful shutdown
+    can all race to emit the final snapshot; exactly one must land."""
+    from benchmark.logs import read_telemetry_stream
+
+    r = Registry()
+    path = tmp_path / "telemetry-f.jsonl"
+    emitter = TelemetryEmitter(r, str(path), node="f")
+    emitter.emit(final=True)
+    emitter.emit(final=True)  # duplicate flush: swallowed
+    snaps = read_telemetry_stream(str(path))
+    assert len(snaps) == 1
+    assert snaps[0]["final"] is True
+
+
+def test_superbatch_per_sig_histogram_resolves_microseconds():
+    """The fine buckets must separate a 25 µs/sig flush from a 60 µs one
+    (both sat in DURATION_MS_BUCKETS' first 0.1 ms bucket)."""
+    from hotstuff_tpu.telemetry import FINE_DURATION_MS_BUCKETS
+
+    r = Registry()
+    h = r.histogram("crypto.superbatch.per_sig_ms", FINE_DURATION_MS_BUCKETS)
+    h.observe(0.025)
+    h.observe(0.060)
+    counts, _, n = h.merged()
+    assert n == 2
+    assert sum(1 for c in counts if c) == 2, "µs regimes share a bucket"
 
 
 # -- round-trace spans ------------------------------------------------------

@@ -1,0 +1,239 @@
+"""Cross-node trace assembly tests: multi-node stream merge (with clock
+skew), missing-node streams, out-of-order sequence numbers, and the
+flight recorder / trace ring primitives they stand on."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from benchmark.trace_assemble import (
+    assemble,
+    assemble_rounds,
+    estimate_offsets,
+    load_events,
+)
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.telemetry import (
+    TraceBuffer,
+    build_trace_record,
+    dump_flight_record,
+    validate_trace_record,
+)
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+# -- helpers: synthesize node streams ---------------------------------------
+
+
+def _write_stream(path, node, events, anchor_mono=0.0, anchor_wall=1000.0):
+    """One telemetry file with one trace record; ``events`` are
+    (seq, node, round, stage, t_mono)."""
+    buf = TraceBuffer(capacity=1024)
+    buf.anchor_mono = anchor_mono
+    buf.anchor_wall = anchor_wall
+    record = build_trace_record(buf, events, node=node)
+    with open(path, "w") as f:
+        f.write(json.dumps(record) + "\n")
+    return str(path)
+
+
+def _round_events(node, r, base, *, leader=False, collector=False):
+    """A plausible single-round timeline for one node, starting at
+    ``base`` (monotonic seconds). Returns (events, next_seq_base)."""
+    seq = r * 100 + hash(node) % 50
+    events = []
+    if leader:
+        events.append((seq + 1, node, r, "propose_send", base))
+    events.append((seq + 2, node, r, "propose", base + 0.002))
+    events.append((seq + 3, node, r, "verified", base + 0.004))
+    events.append((seq + 4, node, r, "vote_send", base + 0.005))
+    if collector:
+        events.append((seq + 5, node, r, "first_vote", base + 0.007))
+        events.append((seq + 6, node, r, "qc", base + 0.010))
+    events.append((seq + 7, node, r, "commit", base + 0.030))
+    return events
+
+
+def _committee_streams(tmp_path, skew: dict[str, float] | None = None):
+    """Three nodes, rounds 1-3: n0 leads, n1 collects. ``skew`` shifts a
+    node's wall anchor (clock skew between hosts)."""
+    skew = skew or {}
+    paths = []
+    for node in ("n0", "n1", "n2"):
+        events = []
+        for r in (1, 2, 3):
+            base = r * 0.1
+            events += _round_events(
+                node, r, base,
+                leader=(node == "n0"), collector=(node == "n1"),
+            )
+        paths.append(
+            _write_stream(
+                tmp_path / f"telemetry-{node}.jsonl",
+                node,
+                events,
+                anchor_wall=1000.0 + skew.get(node, 0.0),
+            )
+        )
+    return paths
+
+
+# -- assembly ---------------------------------------------------------------
+
+
+def test_multi_node_merge_produces_round_timelines(tmp_path):
+    report = assemble(_committee_streams(tmp_path))
+    assert report["rounds"] == 3
+    assert report["total_ms"]["mean"] == pytest.approx(30.0, abs=1.0)
+    edges = report["edges"]
+    # Every causal edge got attribution from the synthetic marks.
+    assert edges["ingress"]["mean_ms"] == pytest.approx(2.0, abs=0.5)
+    assert edges["verify"]["mean_ms"] == pytest.approx(2.0, abs=0.5)
+    assert edges["fanin"]["mean_ms"] == pytest.approx(3.0, abs=0.5)
+    assert edges["qc_to_commit"]["mean_ms"] == pytest.approx(20.0, abs=1.0)
+    assert len(report["top_cost_centers"]) == 3
+    assert report["top_cost_centers"][0] == "qc_to_commit"
+
+
+def test_clock_skew_is_estimated_and_corrected(tmp_path):
+    # n2's wall clock is 50 ms BEHIND: its receives would precede the
+    # leader's send. Alignment must restore causality and keep the
+    # attribution close to the unskewed run.
+    paths = _committee_streams(tmp_path, skew={"n2": -0.050})
+    events = load_events(paths)
+    offsets = estimate_offsets(events)
+    assert offsets.get("n2", 0.0) == pytest.approx(0.048, abs=0.005)
+    rounds = assemble_rounds(events, offsets)
+    assert len(rounds) == 3
+    for rd in rounds:
+        # No negative-wire artifacts: every per-node ingress ≥ 0 and the
+        # fan-out stats stay in the synthetic range.
+        assert rd["fanout"]["ingress"]["max_ms"] < 60.0
+
+
+def test_missing_node_stream_degrades_gracefully(tmp_path):
+    # Drop the collector's stream entirely: first_vote/qc vanish, but
+    # rounds still assemble from commits, with fan-in edges unattributed.
+    paths = [
+        p
+        for p in _committee_streams(tmp_path)
+        if "telemetry-n1" not in p
+    ]
+    report = assemble(paths)
+    assert report["rounds"] == 3
+    for rd in report["per_round"]:
+        assert rd["edges_ms"]["fanin"] is None
+        assert rd["edges_ms"]["qc_to_commit"] is None
+        assert rd["total_ms"] > 0
+
+
+def test_missing_leader_stream_falls_back_to_earliest_sighting(tmp_path):
+    paths = [
+        p
+        for p in _committee_streams(tmp_path)
+        if "telemetry-n0" not in p
+    ]
+    report = assemble(paths)
+    assert report["rounds"] == 3  # propose_send absent; earliest propose wins
+
+
+def test_out_of_order_seq_events_are_resorted(tmp_path):
+    events = []
+    for r in (1, 2):
+        events += _round_events("n0", r, r * 0.1, leader=True, collector=True)
+    shuffled = list(reversed(events))
+    path = _write_stream(tmp_path / "telemetry-n0.jsonl", "n0", shuffled)
+    report = assemble([path])
+    assert report["rounds"] == 2
+    assert report["total_ms"]["mean"] == pytest.approx(30.0, abs=1.0)
+
+
+def test_empty_streams_yield_empty_report(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    path.write_text("")
+    report = assemble([str(path)])
+    assert report["rounds"] == 0
+    assert report["events"] == 0
+
+
+# -- trace ring + flight recorder -------------------------------------------
+
+
+def test_trace_buffer_ring_eviction_and_since():
+    buf = TraceBuffer(capacity=256)
+    for i in range(300):
+        buf.record("n0", i, "propose", t=float(i))
+    assert buf.evicted == 300 - 256
+    events = buf.snapshot_events()
+    assert len(events) == 256
+    assert events[0][0] == 45  # oldest surviving seq
+    tail = buf.events_since(298)
+    assert [e[0] for e in tail] == [299, 300]
+    assert buf.events_since(400) == []
+
+
+def test_trace_record_schema_roundtrip():
+    buf = TraceBuffer(capacity=16)
+    buf.record("n0", 1, "propose")
+    rec = build_trace_record(buf, buf.snapshot_events(), node="n0")
+    rec = json.loads(json.dumps(rec))
+    assert validate_trace_record(rec) == []
+    bad = dict(rec, events=[[1, "n0", "not-an-int", "propose", 0.0]])
+    assert validate_trace_record(bad) != []
+
+
+def test_flight_record_dump(tmp_path):
+    telemetry.enable()
+    registry = telemetry.get_registry()
+    registry.counter("consensus.rounds_advanced").inc(7)
+    buf = telemetry.trace_buffer()
+    telemetry.trace_event("n0", 3, "propose")
+    path = str(tmp_path / "flightrec-x.json")
+    out = dump_flight_record(
+        path, "checker_failure", buf, registry, extra={"note": "t"}
+    )
+    assert out == path
+    with open(path) as f:
+        rec = json.load(f)
+    assert rec["schema"] == "hotstuff-flightrec-v1"
+    assert rec["reason"] == "checker_failure"
+    assert rec["events"] and rec["events"][0][1] == "n0"
+    assert rec["snapshot"]["counters"]["consensus.rounds_advanced"] == 7
+    assert rec["note"] == "t"
+
+
+def test_trace_event_noop_when_disabled():
+    telemetry.trace_event("n0", 1, "propose")
+    assert telemetry.trace_buffer().snapshot_events() == []
+    telemetry.enable()
+    telemetry.trace_event("n0", 1, "propose")
+    assert len(telemetry.trace_buffer().snapshot_events()) == 1
+
+
+def test_round_trace_emits_events_and_counts_evictions():
+    telemetry.enable()
+    registry = telemetry.get_registry()
+    trace = telemetry.round_trace(node="nX")
+    assert trace is not None
+    trace.mark_propose(4)
+    trace.mark_verified(4)
+    trace.mark_vote_send(4)
+    trace.mark_vote(4)
+    trace.mark_qc(4)
+    trace.mark_commit(4)
+    stages = [e[3] for e in telemetry.trace_buffer().snapshot_events()]
+    assert stages == [
+        "propose", "verified", "vote_send", "first_vote", "qc", "commit"
+    ]
+    # FIFO eviction (rounds that never commit) is counted, not silent.
+    for r in range(10, 10 + 600):
+        trace.mark_propose(r)
+    assert registry.counter("consensus.span.evicted_rounds").value() >= 600 - 512
