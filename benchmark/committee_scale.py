@@ -43,6 +43,7 @@ async def run_committee(
     timeout_delay: int,
     profile: bool = False,
     telemetry_path: str | None = None,
+    profiler=None,
 ):
     """Returns ``(seconds_per_round, stage_profile | None)`` where the
     stage profile — measured-window deltas of the registry's
@@ -65,6 +66,9 @@ async def run_committee(
             # in-process stream carries the whole committee's timelines
             # (benchmark/trace_assemble.py merges them per round).
             trace=telemetry.trace_buffer(),
+            # --pyprof: folded-stack profile records interleave too
+            # (benchmark/profile_assemble.py joins them onto the edges).
+            profiler=profiler,
         )
 
     keys = [generate_keypair() for _ in range(n)]
@@ -346,6 +350,19 @@ def main() -> None:
         "snapshot at shutdown; interval via HOTSTUFF_TELEMETRY_INTERVAL)",
     )
     p.add_argument(
+        "--pyprof",
+        nargs="?",
+        const=2.0,
+        type=float,
+        metavar="INTERVAL_MS",
+        help="protocol mode: run the all-thread sampling profiler for "
+        "the whole run (default 2 ms). With --telemetry the folded-stack "
+        "records ride the stream as hotstuff-profile-v1 lines and the "
+        "per-edge function attribution is printed after the run "
+        "(benchmark/profile_assemble.py joins them onto the trace "
+        "edges); without it the top self-time functions are printed.",
+    )
+    p.add_argument(
         "--slo",
         nargs="?",
         const="default",
@@ -366,12 +383,24 @@ def main() -> None:
         run_faults(args)
         return
 
-    if args.telemetry:
+    if args.telemetry or args.pyprof is not None:
         # BEFORE actors/backends are constructed: they capture their
-        # metric objects at creation time.
+        # metric objects at creation time. --pyprof needs this too: the
+        # RoundTrace marks that drive the sampler's stage tags only
+        # exist when telemetry is enabled.
         from hotstuff_tpu import telemetry as _telemetry
 
         _telemetry.enable()
+
+    profiler = None
+    if args.pyprof is not None:
+        if args.mode != "protocol":
+            print("--pyprof requires --mode protocol", file=sys.stderr)
+            sys.exit(2)
+        from hotstuff_tpu.telemetry import profiler as _pyprof
+
+        profiler = _pyprof.SamplingProfiler(interval_ms=args.pyprof)
+        profiler.start(mode="auto")
 
     if args.mode == "protocol":
         # The one-process committee multiplexes N engines' verification
@@ -388,13 +417,18 @@ def main() -> None:
     f = (args.nodes - 1) // 3
     stage_profile = None
     if args.mode == "protocol":
-        per_round, stage_profile = asyncio.run(
-            run_committee(
-                args.nodes, args.rounds, args.base_port, args.timeout,
-                profile=args.profile,
-                telemetry_path=args.telemetry,
+        try:
+            per_round, stage_profile = asyncio.run(
+                run_committee(
+                    args.nodes, args.rounds, args.base_port, args.timeout,
+                    profile=args.profile,
+                    telemetry_path=args.telemetry,
+                    profiler=profiler,
+                )
             )
-        )
+        finally:
+            if profiler is not None:
+                profiler.stop()
     else:
         per_round = run_crypto_rounds(args.nodes, args.rounds, args.tc_heavy)
     # Ask the network package what it ACTUALLY selected (HOTSTUFF_NET=native
@@ -442,6 +476,29 @@ def main() -> None:
             out.write(line + "\n")
             for pl in profile_lines:
                 out.write(pl + "\n")
+
+    if profiler is not None:
+        print(
+            f"pyprof: {profiler.samples} samples @ {profiler.interval_ms} ms "
+            f"({profiler.mode} mode), GIL delay "
+            f"{profiler.gil_delay_ns / 1e6:.1f} ms"
+        )
+        if args.telemetry:
+            # The emitter drained the folded stacks into the stream:
+            # join them onto the trace edges for the printed answer.
+            from benchmark.profile_assemble import _human, attribute
+
+            print(_human(attribute([args.telemetry])))
+        else:
+            self_c, cum_c, _samples = profiler.self_cum()
+            total = sum(self_c.values())
+            if total:
+                print(f"{'SELF%':>6} {'CUM%':>6}  function")
+                for fn, n in self_c.most_common(20):
+                    print(
+                        f"{100 * n / total:6.2f} {100 * cum_c[fn] / total:6.2f}"
+                        f"  {fn}"
+                    )
 
     if args.slo:
         if not args.telemetry:

@@ -35,8 +35,10 @@ from re import findall, search
 from statistics import mean
 
 from hotstuff_tpu.telemetry import (
+    PROFILE_SCHEMA,
     SCHEMA as SNAPSHOT_SCHEMA,
     TRACE_SCHEMA,
+    validate_profile_record,
     validate_snapshot,
     validate_trace_record,
 )
@@ -245,18 +247,20 @@ class StreamRecords:
     """One parsed telemetry stream, by record schema.
 
     ``snapshots`` are the ``hotstuff-telemetry-v1`` lines, ``traces`` the
-    interleaved ``hotstuff-trace-v1`` lines, ``skipped`` counts lines
-    that could not be used: a truncated FINAL line (a node crashed or was
-    SIGKILLed mid-write — expected under chaos, never fatal) and lines of
-    unknown schema (forward compatibility). Malformed JSON anywhere but
-    the last line still raises — mid-file corruption is a real bug, not
-    crash fallout."""
+    interleaved ``hotstuff-trace-v1`` lines, ``profiles`` the
+    ``hotstuff-profile-v1`` sampling-profiler lines, ``skipped`` counts
+    lines that could not be used: a truncated FINAL line (a node crashed
+    or was SIGKILLed mid-write — expected under chaos, never fatal) and
+    lines of unknown schema (forward compatibility). Malformed JSON
+    anywhere but the last line still raises — mid-file corruption is a
+    real bug, not crash fallout."""
 
-    __slots__ = ("snapshots", "traces", "skipped")
+    __slots__ = ("snapshots", "traces", "profiles", "skipped")
 
     def __init__(self) -> None:
         self.snapshots: list[dict] = []
         self.traces: list[dict] = []
+        self.profiles: list[dict] = []
         self.skipped = 0
 
 
@@ -286,6 +290,11 @@ def read_stream_records(path: str) -> StreamRecords:
             if problems:
                 raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
             records.traces.append(obj)
+        elif schema == PROFILE_SCHEMA:
+            problems = validate_profile_record(obj)
+            if problems:
+                raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
+            records.profiles.append(obj)
         else:
             records.skipped += 1
     return records

@@ -104,22 +104,36 @@ def load_crypto_baseline(repo_root: str) -> dict | None:
     return best
 
 
-def measure_protocol(nodes: int, rounds: int, repeats: int, base_port: int):
-    """(min ms/round, telemetry context) for the current stack."""
+def measure_protocol(
+    nodes: int, rounds: int, repeats: int, base_port: int, pyprof: bool = False
+):
+    """(min ms/round, telemetry context) for the current stack. With
+    ``pyprof`` the sampling profiler runs across the repeats and the
+    context gains the top self-time functions — a regression artifact
+    then carries its own first function-level diagnosis."""
     from benchmark.committee_scale import run_committee
     from hotstuff_tpu import telemetry
+    from hotstuff_tpu.telemetry import profiler as pyprof_mod
 
     telemetry.enable()
     registry = telemetry.get_registry()
+    profiler = None
+    if pyprof:
+        profiler = pyprof_mod.SamplingProfiler()
+        profiler.start(mode="auto")
     best = float("inf")
     port = base_port
     before = registry.snapshot()["counters"]
-    for _ in range(repeats):
-        per_round, _ = asyncio.run(
-            run_committee(nodes, rounds, port, timeout_delay=30_000)
-        )
-        best = min(best, per_round)
-        port += 2 * nodes
+    try:
+        for _ in range(repeats):
+            per_round, _ = asyncio.run(
+                run_committee(nodes, rounds, port, timeout_delay=30_000)
+            )
+            best = min(best, per_round)
+            port += 2 * nodes
+    finally:
+        if profiler is not None:
+            profiler.stop()
     deltas = telemetry.diff_counters(before, registry.snapshot()["counters"])
     context = {
         k: v
@@ -132,6 +146,14 @@ def measure_protocol(nodes: int, rounds: int, repeats: int, base_port: int):
             "consensus.span.evicted_rounds",
         )
     }
+    if profiler is not None:
+        self_c, _cum, _ = profiler.self_cum()
+        total = sum(self_c.values()) or 1
+        context["profile_top"] = [
+            {"fn": fn, "self_share": round(n / total, 4)}
+            for fn, n in self_c.most_common(10)
+        ]
+        context["profile_samples"] = profiler.samples
     return best * 1e3, context
 
 
@@ -161,6 +183,12 @@ def main() -> None:
     p.add_argument("--base-port", type=int, default=25000)
     p.add_argument("--skip-protocol", action="store_true")
     p.add_argument("--skip-crypto", action="store_true")
+    p.add_argument(
+        "--pyprof", action="store_true",
+        help="sample the protocol measurement and attach the top "
+        "self-time functions to the artifact (a red gate then names "
+        "its own suspects)",
+    )
     p.add_argument("--output", help="directory for the JSON artifact")
     args = p.parse_args()
 
@@ -182,7 +210,8 @@ def main() -> None:
         rows = load_protocol_baselines(os.path.join(REPO_ROOT, "results"))
         baseline = best_protocol_baseline(rows, args.nodes, backend, transport)
         fresh_ms, context = measure_protocol(
-            args.nodes, args.rounds, args.repeats, args.base_port
+            args.nodes, args.rounds, args.repeats, args.base_port,
+            pyprof=args.pyprof,
         )
         check = {
             "metric": f"protocol_ms_per_round_n{args.nodes}",

@@ -1,88 +1,81 @@
-"""Sampling profiler for the in-process committee (1-core box).
+"""Sampling profiler CLI for the in-process committee (1-core box).
 
-cProfile's tracing overhead multiplies asyncio's per-event cost so much
-that an N=40 committee cannot even form its mesh inside a CI window; a
-SIGPROF sampler costs one stack walk per interval (~0.3% at 2 ms) and
-leaves the timing honest. Aggregates leaf-ward self time and rolled-up
-cumulative time per function.
+Thin wrapper over ``hotstuff_tpu.telemetry.profiler.SamplingProfiler`` —
+the one sampler implementation in the tree (this script used to carry
+its own main-thread-only SIGPROF walker; the telemetry profiler walks
+ALL threads via ``sys._current_frames`` and tags samples with the
+active round-trace stage). cProfile's tracing overhead multiplies
+asyncio's per-event cost so much that an N=40 committee cannot even
+form its mesh inside a CI window; a SIGPROF sampler costs one stack
+walk per interval (~0.3% at 2 ms) and leaves the timing honest.
 
     python -m benchmark.sample_profile --nodes 40 --rounds 15
+
+For per-trace-edge attribution (which functions inside which edge), use
+``committee_scale --pyprof --telemetry`` + ``profile_assemble`` instead.
 """
 
 from __future__ import annotations
 
 import argparse
 import asyncio
-import collections
 import os
-import signal
 import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-_samples: collections.Counter[tuple[str, ...]] = collections.Counter()
-_self: collections.Counter[str] = collections.Counter()
-_cum: collections.Counter[str] = collections.Counter()
-_nsamples = 0
-
-
-def _frame_id(frame) -> str:
-    code = frame.f_code
-    fn = code.co_filename
-    # Compress to repo-relative / stdlib-basename names.
-    for marker in ("/hotstuff_tpu/", "/benchmark/"):
-        if marker in fn:
-            fn = marker.strip("/") + "/" + fn.split(marker, 1)[1]
-            break
-    else:
-        fn = os.path.basename(fn)
-    return f"{fn}:{code.co_firstlineno}:{code.co_name}"
-
-
-def _on_prof(signum, frame) -> None:
-    global _nsamples
-    if frame is None:  # delivered with no Python frame current
-        return
-    _nsamples += 1
-    stack = []
-    f = frame
-    while f is not None:
-        stack.append(_frame_id(f))
-        f = f.f_back
-    _self[stack[0]] += 1
-    for name in set(stack):
-        _cum[name] += 1
-
 
 def main() -> None:
-    p = argparse.ArgumentParser()
+    p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--nodes", type=int, default=40)
     p.add_argument("--rounds", type=int, default=15)
     p.add_argument("--base-port", type=int, default=22000)
     p.add_argument("--interval-ms", type=float, default=2.0)
     p.add_argument("--top", type=int, default=35)
+    p.add_argument(
+        "--by-stage", action="store_true",
+        help="break the table down by round-trace stage tag "
+        "(requires telemetry marks; enabled automatically)",
+    )
     args = p.parse_args()
 
     from benchmark.committee_scale import run_committee
+    from hotstuff_tpu import telemetry
+    from hotstuff_tpu.telemetry import profiler as pyprof
 
-    signal.signal(signal.SIGPROF, _on_prof)
-    signal.setitimer(
-        signal.ITIMER_PROF, args.interval_ms / 1e3, args.interval_ms / 1e3
-    )
-    per_round, _ = asyncio.run(
-        run_committee(args.nodes, args.rounds, args.base_port, 30_000)
-    )
-    signal.setitimer(signal.ITIMER_PROF, 0)
+    telemetry.enable()  # the stage tags come from RoundTrace marks
+    profiler = pyprof.SamplingProfiler(interval_ms=args.interval_ms)
+    profiler.start(mode="auto")
+    try:
+        per_round, _ = asyncio.run(
+            run_committee(args.nodes, args.rounds, args.base_port, 30_000)
+        )
+    finally:
+        profiler.stop()
 
     print(
         f"\ncommittee={args.nodes} protocol: {per_round * 1e3:.1f} ms/round; "
-        f"{_nsamples} samples @ {args.interval_ms} ms (whole run incl. boot)"
+        f"{profiler.samples} samples @ {args.interval_ms} ms "
+        f"({profiler.mode} mode, whole run incl. boot); "
+        f"GIL delay {profiler.gil_delay_ns / 1e6:.1f} ms"
     )
+
+    if args.by_stage:
+        per_stage = {
+            stage or "(untagged)": n
+            for stage, n in profiler.stage_totals().items()
+        }
+        total = sum(per_stage.values()) or 1
+        print("\nsamples by round-trace stage:")
+        for stage, n in sorted(per_stage.items(), key=lambda kv: -kv[1]):
+            print(f"  {stage:<14} {n:>8} ({100 * n / total:5.1f}%)")
+
+    self_c, cum_c, _ = profiler.self_cum()
+    total = sum(self_c.values()) or 1
     print(f"\n{'SELF%':>6} {'CUM%':>6}  function")
-    for name, n in _self.most_common(args.top):
+    for name, n in self_c.most_common(args.top):
         print(
-            f"{100 * n / _nsamples:6.2f} {100 * _cum[name] / _nsamples:6.2f}"
-            f"  {name}"
+            f"{100 * n / total:6.2f} {100 * cum_c[name] / total:6.2f}  {name}"
         )
 
 

@@ -47,7 +47,7 @@ from statistics import median
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from benchmark.logs import read_stream_records  # noqa: E402
+from benchmark.logs import ParseError, read_stream_records  # noqa: E402
 
 REPORT_SCHEMA = "hotstuff-trace-critical-path-v1"
 
@@ -60,15 +60,37 @@ REPORT_SCHEMA = "hotstuff-trace-critical-path-v1"
 EDGES = ("ingress", "verify", "vote", "vote_wire", "fanin", "qc_to_commit")
 
 
-def load_events(paths: list[str]) -> list[dict]:
+def load_events(
+    paths: list[str], skipped_streams: list[str] | None = None
+) -> list[dict]:
     """All trace events across streams as dicts with wall-mapped times.
     Events are re-sorted by (node, seq): a stream's lines can land
-    interleaved/out of order when processes share a file."""
+    interleaved/out of order when processes share a file.
+
+    A stream that cannot contribute — unreadable/corrupt, or trace
+    records missing the wall-clock **anchor** that maps their monotonic
+    timestamps onto the shared timeline — is skipped with a warning and
+    recorded in ``skipped_streams`` (when a list is given) instead of
+    aborting the whole assembly or vanishing silently: one crashed
+    node's stream must not cost the other N-1 nodes' timeline, but the
+    report has to say the attribution is partial."""
     events: list[dict] = []
     for path in paths:
-        records = read_stream_records(path)
+        try:
+            records = read_stream_records(path)
+        except (ParseError, OSError) as e:
+            print(f"WARN: skipping stream {path}: {e}", file=sys.stderr)
+            if skipped_streams is not None:
+                skipped_streams.append(os.path.basename(path))
+            continue
+        bad_anchor = False
         for rec in records.traces:
-            anchor = rec["anchor"]
+            anchor = rec.get("anchor") or {}
+            if not all(
+                isinstance(anchor.get(k), (int, float)) for k in ("mono", "wall")
+            ):
+                bad_anchor = True
+                continue
             off = anchor["wall"] - anchor["mono"]
             for seq, node, round_, stage, t in rec["events"]:
                 events.append(
@@ -81,6 +103,14 @@ def load_events(paths: list[str]) -> list[dict]:
                         "stream": path,
                     }
                 )
+        if bad_anchor:
+            print(
+                f"WARN: {path}: trace record(s) without a wall-clock "
+                "anchor skipped (cannot place on the shared timeline)",
+                file=sys.stderr,
+            )
+            if skipped_streams is not None:
+                skipped_streams.append(os.path.basename(path))
     events.sort(key=lambda e: (e["stream"], e["node"], e["seq"]))
     return events
 
@@ -284,13 +314,15 @@ def summarize(rounds: list[dict], top: int = 5) -> dict:
 def assemble(
     paths: list[str], *, align: bool = True, top: int = 5
 ) -> dict:
-    events = load_events(paths)
+    skipped: list[str] = []
+    events = load_events(paths, skipped_streams=skipped)
     offsets = estimate_offsets(events) if align else {}
     rounds = assemble_rounds(events, offsets)
     report = {
         "schema": REPORT_SCHEMA,
         "streams": [os.path.basename(p) for p in paths],
         "events": len(events),
+        "skipped_streams": sorted(set(skipped)),
         "clock_offsets_s": {
             n: round(o, 6) for n, o in sorted(offsets.items())
         },
@@ -303,7 +335,12 @@ def assemble(
 def _human(report: dict) -> str:
     lines = [
         f"assembled {report['rounds']} committed rounds from "
-        f"{report['events']} events across {len(report['streams'])} stream(s)",
+        f"{report['events']} events across {len(report['streams'])} stream(s)"
+        + (
+            f" ({len(report['skipped_streams'])} skipped: no usable anchor)"
+            if report.get("skipped_streams")
+            else ""
+        ),
         f"round total: mean {report['total_ms']['mean']} ms, "
         f"p90 {report['total_ms']['p90']} ms, max {report['total_ms']['max']} ms",
         f"{'edge':<14} {'mean ms':>9} {'p90 ms':>9} {'max ms':>9} {'share':>7}",

@@ -13,6 +13,7 @@ import logging
 from hotstuff_tpu.crypto import PublicKey, SignatureService
 from hotstuff_tpu.network import MessageHandler, Receiver
 from hotstuff_tpu.store import Store
+from hotstuff_tpu.telemetry import profiler as pyprof
 from hotstuff_tpu.utils.serde import SerdeError
 
 from .config import Committee, Parameters
@@ -36,6 +37,11 @@ class ConsensusReceiverHandler(MessageHandler):
         self.tx_helper = tx_helper
 
     async def dispatch(self, writer, serialized: bytes) -> None:
+        if pyprof.TAGGING:
+            # Message decode is the function-level heart of the trace's
+            # ingress edge (a proposal decode parses a 2f+1-sig QC); tag
+            # it so the sampler blames decode frames on ingress.
+            pyprof.set_thread_stage("ingress")
         try:
             kind, payload = decode_message(serialized)
         except (SerdeError, MalformedMessage, ValueError) as e:
@@ -56,6 +62,8 @@ class ConsensusReceiverHandler(MessageHandler):
         put for the whole batch (the core re-checks round/authority and
         performs the full signature verification — the pre-stage is a
         filter, never a trust root)."""
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("fanin")
         votes = []
         for frame in frames:
             try:

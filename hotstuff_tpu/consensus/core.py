@@ -26,6 +26,7 @@ import asyncio
 import logging
 
 from hotstuff_tpu import telemetry
+from hotstuff_tpu.telemetry import profiler as pyprof
 from hotstuff_tpu.crypto import PublicKey, SignatureService
 from hotstuff_tpu.faultline import hooks as _faultline
 from hotstuff_tpu.network import SimpleSender
@@ -820,6 +821,21 @@ class Core:
             "qc_retry": self._handle_qc_retry,  # internal loopback
             "loopback": self.process_block,
         }
+        # Sampling-profiler stage seeds: each dequeued event opens under
+        # the trace edge its handler starts in; the RoundTrace marks then
+        # refine the tag as the handler crosses edge boundaries (e.g. a
+        # "propose" event opens as ingress work — dedup lookups, leader
+        # checks — until mark_propose flips it to verify). One module
+        # attribute read per event when no profiler session is live.
+        stage_seeds = {
+            "propose": "ingress",
+            "vote": "fanin",
+            "votes": "fanin",
+            "timeout": "view_change",
+            "tc": "view_change",
+            "qc_retry": "verify",
+            "loopback": "vote",
+        }
         self._timer_handled = asyncio.Event()
         timer_task = asyncio.create_task(self._timer_pump(), name="consensus_timer")
         if self._on_round_advance is not None:
@@ -848,6 +864,8 @@ class Core:
                     self._timer_handled.set()
                     continue
                 handler = handlers.get(kind)
+                if pyprof.TAGGING:
+                    pyprof.set_thread_stage(stage_seeds.get(kind, "other"))
                 if handler is None:
                     log.error("unexpected protocol message kind %s", kind)
                 elif not profile:
@@ -863,6 +881,10 @@ class Core:
                     await self._guarded(handler(payload))
                     pair[0].inc(_time.perf_counter_ns() - t0)
                     pair[1].inc()
+                if pyprof.TAGGING:
+                    # Back to the queue wait: samples here are event-loop
+                    # idle/dispatch cost, not the last handler's edge.
+                    pyprof.set_thread_stage("idle")
         finally:
             timer_task.cancel()
 

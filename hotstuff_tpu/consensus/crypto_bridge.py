@@ -25,6 +25,8 @@ import asyncio
 import logging
 from concurrent.futures import ThreadPoolExecutor
 
+from hotstuff_tpu.telemetry import profiler as pyprof
+
 log = logging.getLogger("consensus")
 
 _EXECUTOR: ThreadPoolExecutor | None = None
@@ -82,4 +84,13 @@ async def verify_off_loop(verify_fn, *args, n_sigs: int = 1):
     if not _backend_blocks() and n_sigs < INLINE_SIG_LIMIT:
         return verify_fn(*args)
     loop = asyncio.get_running_loop()
+    if pyprof.TAGGING:
+        # The verification runs on a crypto worker thread; tag that
+        # thread for the sampling profiler so its stack samples join the
+        # trace's verify edge instead of landing unstaged.
+        def _tagged():
+            with pyprof.stage("verify"):
+                return verify_fn(*args)
+
+        return await loop.run_in_executor(_executor(), _tagged)
     return await loop.run_in_executor(_executor(), lambda: verify_fn(*args))

@@ -92,8 +92,20 @@ def _load():
         # The engine's counters surface through the registry's single
         # snapshot call once the library is live.
         from hotstuff_tpu import telemetry
+        from hotstuff_tpu.telemetry import profiler as _pyprof
 
         telemetry.register_collector("crypto.native", native_stats)
+        # Instrumentable ctypes boundary: an active profiler session
+        # counts calls + wall ns per entry point (the per-call GIL
+        # release/reacquire toll); zero cost otherwise.
+        _pyprof.register_ctypes_lib(
+            lib,
+            "hs_ed25519",
+            [
+                "hs_ed25519_msm_is_identity", "hs_ed25519_msm_signed",
+                "hs_ed25519_decompress_check", "hs_ed25519_scalarmult_base",
+            ],
+        )
     return _lib
 
 

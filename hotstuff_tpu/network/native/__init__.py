@@ -167,16 +167,37 @@ def _load():
         lib.hs_net_stats_ex.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
         ]
+        # Make the hs_net_* boundary instrumentable: an active profiler
+        # session wraps these entry points to count calls + wall ns (the
+        # per-call ctypes/GIL toll); zero cost otherwise.
+        from hotstuff_tpu.telemetry import profiler as _pyprof
+
+        _pyprof.register_ctypes_lib(
+            lib,
+            "hs_net",
+            [
+                "hs_net_send", "hs_net_broadcast", "hs_net_set_round",
+                "hs_net_consumed", "hs_net_reply", "hs_net_cancel",
+                "hs_net_drain", "hs_net_set_vote_filter",
+            ],
+        )
         _lib = lib
     return _lib
 
 
 # hs_net_stats_ex field order (new fields append; indices never move).
+# The last six are the poll-loop timing account (cumulative; snapshot
+# deltas give rates/means): time inside epoll_wait vs dispatching
+# events, and how long commands sat in the queue before the loop
+# serviced them — the C++ half of the ctypes-boundary latency the
+# sampling profiler measures on the Python side.
 STATS_FIELDS = (
     "pending", "inflight", "cancelled", "out_conns", "in_conns",
     "votes_batched", "votes_dropped", "votes_dropped_dup",
     "frames_rx", "bytes_rx", "frames_tx", "bytes_tx",
     "writev_calls", "send_drops", "faults_dropped", "faults_delayed",
+    "loop_polls", "poll_ns", "dispatch_ns",
+    "cmds_serviced", "cmd_service_ns", "cmd_service_max_ns",
 )
 
 # Rate limit for the loop-side drop warnings (satellite: silent filtering

@@ -71,6 +71,12 @@ uint64_t now_ms() {
   return uint64_t(ts.tv_sec) * 1000 + uint64_t(ts.tv_nsec) / 1000000;
 }
 
+uint64_t now_ns() {
+  timespec ts;
+  clock_gettime(CLOCK_MONOTONIC, &ts);
+  return uint64_t(ts.tv_sec) * 1000000000ull + uint64_t(ts.tv_nsec);
+}
+
 void frame_append(std::string& out, const uint8_t* data, uint32_t len) {
   char hdr[4] = {char(len >> 24), char(len >> 16), char(len >> 8), char(len)};
   out.append(hdr, 4);
@@ -151,6 +157,16 @@ struct StatsReq {
                             // SIMPLE_QUEUE_CAP back-pressure bound
   uint64_t faults_dropped = 0;  // frames eaten by the hs_net_faults table
   uint64_t faults_delayed = 0;  // frames held by the hs_net_faults table
+  // Poll-loop timing (the C++ side of every trace edge): where the loop
+  // thread's wall time goes, and how long commands sit in the queue
+  // before the loop services them. Cumulative ns + counts — readers
+  // derive means/rates from snapshot deltas (telemetry collector).
+  uint64_t loop_polls = 0;          // epoll_wait calls
+  uint64_t poll_ns = 0;             // wall ns inside epoll_wait (idle+block)
+  uint64_t dispatch_ns = 0;         // wall ns handling events/commands/flushes
+  uint64_t cmds_serviced = 0;       // commands drained by run_commands
+  uint64_t cmd_service_ns = 0;      // sum of enqueue->service latency
+  uint64_t cmd_service_max_ns = 0;  // worst single command latency
 };
 
 struct Command {
@@ -162,6 +178,7 @@ struct Command {
   bool flag = false;  // ADD_LISTENER: auto_ack
   uint64_t count = 0;   // CONSUMED: frames; ADD_LISTENER: high<<32|low
   void* ptr = nullptr;  // STATS: StatsReq*
+  uint64_t enq_ns = 0;  // stamped by push_cmd (cmd-queue service latency)
   std::string payload;
 };
 
@@ -365,6 +382,7 @@ class NetCore {
   // — which must take cmd_mu_ to enqueue CMD_STOP — cannot proceed until
   // any in-flight enqueue+wake has fully completed.
   bool push_cmd(Command&& c) {
+    c.enq_ns = now_ns();
     std::lock_guard<std::mutex> g(cmd_mu_);
     if (!accepting_) return false;
     commands_.push_back(std::move(c));
@@ -445,7 +463,11 @@ class NetCore {
     std::vector<epoll_event> evs(256);
     while (!stop_) {
       int timeout = next_timeout();
+      uint64_t t_poll = now_ns();
       int n = epoll_wait(epfd_, evs.data(), int(evs.size()), timeout);
+      uint64_t t_wake = now_ns();
+      loop_polls_++;
+      poll_ns_ += t_wake - t_poll;
       uint64_t now = now_ms();
       for (int i = 0; i < n; i++) {
         uint64_t tag = evs[i].data.u64;
@@ -476,6 +498,7 @@ class NetCore {
           start_connect(c);
         }
       }
+      dispatch_ns_ += now_ns() - t_wake;
     }
     // Stop accepting, then complete any synchronous requests that were
     // enqueued before the flag flipped — without this a caller blocked
@@ -530,6 +553,19 @@ class NetCore {
     {
       std::lock_guard<std::mutex> g(cmd_mu_);
       cmds.swap(commands_);
+    }
+    if (!cmds.empty()) {
+      // Queue-service latency: how long each command waited between the
+      // caller's push_cmd and this drain — the ctypes boundary's loop-
+      // side half (the Python side is accounted by the sampling
+      // profiler's ctypes wrappers).
+      uint64_t t_service = now_ns();
+      for (auto& c : cmds) {
+        uint64_t waited = t_service > c.enq_ns ? t_service - c.enq_ns : 0;
+        cmd_service_ns_ += waited;
+        if (waited > cmd_service_max_ns_) cmd_service_max_ns_ = waited;
+      }
+      cmds_serviced_ += cmds.size();
     }
     for (auto& c : cmds) {
       switch (c.type) {
@@ -683,6 +719,12 @@ class NetCore {
           s->send_drops = send_drops_;
           s->faults_dropped = faults_dropped_;
           s->faults_delayed = faults_delayed_;
+          s->loop_polls = loop_polls_;
+          s->poll_ns = poll_ns_;
+          s->dispatch_ns = dispatch_ns_;
+          s->cmds_serviced = cmds_serviced_;
+          s->cmd_service_ns = cmd_service_ns_;
+          s->cmd_service_max_ns = cmd_service_max_ns_;
           {
             // notify under the lock: after the unlock the waiter may
             // (spurious wakeup) observe done and destroy the
@@ -1359,6 +1401,12 @@ class NetCore {
   uint64_t send_drops_ = 0;
   uint64_t faults_dropped_ = 0;
   uint64_t faults_delayed_ = 0;
+  uint64_t loop_polls_ = 0;  // poll-loop timing (all loop thread only)
+  uint64_t poll_ns_ = 0;
+  uint64_t dispatch_ns_ = 0;
+  uint64_t cmds_serviced_ = 0;
+  uint64_t cmd_service_ns_ = 0;
+  uint64_t cmd_service_max_ns_ = 0;
 
   std::unordered_map<uint64_t, Listener> listeners_;  // loop thread only
   std::unordered_map<uint64_t, InConn> in_conns_;
@@ -1532,12 +1580,15 @@ void hs_net_stats(void* ctx, uint64_t* out) {
 // Extended snapshot: fills up to ``cap`` slots in the order
 // {pending, inflight, cancelled, out_conns, in_conns, votes_batched,
 //  votes_dropped, votes_dropped_dup, frames_rx, bytes_rx, frames_tx,
-//  bytes_tx, writev_calls, send_drops, faults_dropped, faults_delayed}
+//  bytes_tx, writev_calls, send_drops, faults_dropped, faults_delayed,
+//  loop_polls, poll_ns, dispatch_ns, cmds_serviced, cmd_service_ns,
+//  cmd_service_max_ns}
 // and returns the number filled (new fields append, existing indices
 // never move — callers probe the return value instead of pinning a
 // struct version). Same loop-thread servicing — and the same
 // no-race-with-destroy contract — as hs_net_stats.
 int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
+  constexpr int N_FIELDS = 22;
   if (out == nullptr || cap <= 0) return 0;
   StatsReq req;
   Command c;
@@ -1545,19 +1596,21 @@ int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
   c.ptr = &req;
   if (!static_cast<NetCore*>(ctx)->push_cmd(std::move(c))) {
     for (int i = 0; i < cap; i++) out[i] = 0;
-    return cap < 16 ? cap : 16;
+    return cap < N_FIELDS ? cap : N_FIELDS;
   }
   std::unique_lock<std::mutex> lk(req.mu);
   req.cv.wait(lk, [&] { return req.done; });
-  const uint64_t fields[16] = {
+  const uint64_t fields[N_FIELDS] = {
       req.pending,       req.inflight,     req.cancelled,
       req.out_conns,     req.in_conns,     req.votes_batched,
       req.votes_dropped, req.votes_dropped_dup, req.frames_rx,
       req.bytes_rx,      req.frames_tx,    req.bytes_tx,
       req.writev_calls,  req.send_drops,   req.faults_dropped,
-      req.faults_delayed,
+      req.faults_delayed, req.loop_polls,  req.poll_ns,
+      req.dispatch_ns,   req.cmds_serviced, req.cmd_service_ns,
+      req.cmd_service_max_ns,
   };
-  int n = cap < 16 ? cap : 16;
+  int n = cap < N_FIELDS ? cap : N_FIELDS;
   for (int i = 0; i < n; i++) out[i] = fields[i];
   return n;
 }

@@ -124,6 +124,28 @@ class Node:
             benchmark=benchmark,
         )
 
+        # Resource observability (RSS, on-disk store size, optional
+        # tracemalloc) + the continuous sampling profiler. The resource
+        # collector is registered whenever telemetry is on — it costs
+        # nothing until a snapshot polls it; the profiler is opt-in via
+        # HOTSTUFF_PYPROF=1 (HOTSTUFF_PYPROF_INTERVAL_MS tunes the
+        # cadence) and its hotstuff-profile-v1 records ride the node's
+        # snapshot stream via the emitter below.
+        if telemetry.enabled():
+            from hotstuff_tpu.telemetry import profiler as pyprof, resources
+
+            resources.install(store_path=store_path)
+            if os.environ.get("HOTSTUFF_PYPROF") and pyprof.active() is None:
+                prof = pyprof.SamplingProfiler(
+                    interval_ms=pyprof.env_interval_ms()
+                )
+                prof.start(mode="auto")
+                telemetry.register_collector("profile", prof.collector)
+                log.info(
+                    "sampling profiler armed (%s mode, %.1f ms)",
+                    prof.mode, prof.interval_ms,
+                )
+
         # Telemetry snapshot stream (HOTSTUFF_TELEMETRY[_DIR]): periodic
         # JSON-lines snapshots plus a final one at shutdown —
         # benchmark/logs.py reads these alongside the regex log scrape.

@@ -44,6 +44,11 @@ from .emitter import (
     build_snapshot,
     validate_snapshot,
 )
+from .profiler import (
+    PROFILE_SCHEMA,
+    SamplingProfiler,
+    validate_profile_record,
+)
 from .registry import (
     COUNT_BUCKETS,
     DURATION_MS_BUCKETS,
@@ -73,6 +78,9 @@ __all__ = [
     "SCHEMA",
     "TRACE_SCHEMA",
     "FLIGHT_SCHEMA",
+    "PROFILE_SCHEMA",
+    "SamplingProfiler",
+    "validate_profile_record",
     "DEFAULT_INTERVAL_S",
     "Counter",
     "Gauge",
@@ -340,6 +348,10 @@ def trace_event(node: str, round_: int, stage: str) -> None:
 def reset_for_tests() -> None:
     """Clear registry, tables, trace ring, and enablement (isolation)."""
     global _ENABLED
+    from . import profiler as _profiler, resources as _resources
+
+    _profiler.reset_for_tests()
+    _resources.reset_for_tests()
     _REGISTRY.reset()
     _TRACE_BUFFER.clear()
     with _tables_lock:

@@ -34,6 +34,7 @@ import os
 import signal
 import time
 
+from . import profiler as pyprof
 from .trace import build_trace_record, dump_flight_record
 
 log = logging.getLogger("telemetry")
@@ -119,12 +120,17 @@ class TelemetryEmitter:
         node: str = "",
         interval_s: float = DEFAULT_INTERVAL_S,
         trace=None,
+        profiler=None,
     ) -> None:
         self.registry = registry
         self.path = path
         self.node = node
         self.interval_s = max(float(interval_s), 0.05)
         self.trace = trace  # TraceBuffer or None
+        # SamplingProfiler, or None to follow the process-active session
+        # lazily (nodes arm the profiler from the environment after the
+        # emitter exists; a fixed None would silently drop its records).
+        self.profiler = profiler
         self._trace_seq = 0  # last trace event seq already streamed
         self._seq = 0
         self._final_done = False
@@ -151,6 +157,14 @@ class TelemetryEmitter:
                 self._trace_seq = events[-1][0]
                 record = build_trace_record(self.trace, events, node=self.node)
                 lines.append(json.dumps(record, separators=(",", ":")))
+        prof = self.profiler if self.profiler is not None else pyprof.active()
+        if prof is not None:
+            # Folded stacks sampled since the previous emit ride the same
+            # stream as one ``hotstuff-profile-v1`` line (delta, like
+            # trace events; the sampler keeps nothing after the drain).
+            profile = prof.drain_record(node=self.node)
+            if profile is not None:
+                lines.append(json.dumps(profile, separators=(",", ":")))
         try:
             with open(self.path, "a") as f:
                 f.write("\n".join(lines) + "\n")

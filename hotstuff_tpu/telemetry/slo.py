@@ -24,6 +24,11 @@ Kinds:
   (timeouts per round). Zero denominator skips the window.
 - ``gauge_max``: the gauge's value in every snapshot of the window ≤
   ``max`` (mempool queue depth).
+- ``gauge_growth``: the gauge's per-second growth across the window,
+  ``(after - before) / window_s`` ≤ ``max`` (RSS / store-size growth —
+  the unbounded-growth failure mode long soaks exist to catch). Windows
+  where the gauge is absent at either end are skipped; negative growth
+  (GC, compaction) always passes a max bound.
 
 Counter resets (node restart mid-stream) make a cumulative value go
 DOWN; a reset-aware delta treats that as "counted from zero again" and
@@ -64,7 +69,10 @@ class SloSpec:
         min: float | None = None,  # noqa: A002
         allow_violation_fraction: float = 0.0,
     ) -> None:
-        if kind not in ("quantile", "ms_per_count", "rate", "ratio", "gauge_max"):
+        if kind not in (
+            "quantile", "ms_per_count", "rate", "ratio", "gauge_max",
+            "gauge_growth",
+        ):
             raise ValueError(f"unknown SLO kind {kind!r}")
         if kind == "quantile" and not (q and 0.0 < q < 1.0):
             raise ValueError(f"quantile SLO {name!r} needs 0 < q < 1")
@@ -137,6 +145,34 @@ def default_slos(
             "timeouts_per_round", "ratio",
             "consensus.timeouts_fired", per="consensus.rounds_advanced",
             max=timeouts_per_round,
+            allow_violation_fraction=allow_violation_fraction,
+        ),
+    ]
+
+
+def memory_slos(
+    *,
+    rss_growth_bytes_per_s: float = 8 * 1024 * 1024,
+    store_growth_bytes_per_s: float = 32 * 1024 * 1024,
+    allow_violation_fraction: float = 0.0,
+) -> list[SloSpec]:
+    """The memory-growth gate (ROADMAP item 4's unbounded-growth failure
+    mode): RSS and on-disk store size must grow slower than a bound in
+    every window. The gauges come from ``telemetry/resources.py``
+    (``resource.rss_bytes`` / ``resource.store_bytes``); streams without
+    them (resource collector not installed) skip these specs. Store
+    growth is workload-proportional — the default bound is a ceiling on
+    runaway WAL/MetaLog growth, not a tight fit; soaks tune it to their
+    input rate."""
+    return [
+        SloSpec(
+            "rss_growth_bytes_per_s", "gauge_growth",
+            "resource.rss_bytes", max=rss_growth_bytes_per_s,
+            allow_violation_fraction=allow_violation_fraction,
+        ),
+        SloSpec(
+            "store_growth_bytes_per_s", "gauge_growth",
+            "resource.store_bytes", max=store_growth_bytes_per_s,
             allow_violation_fraction=allow_violation_fraction,
         ),
     ]
@@ -263,6 +299,15 @@ def _eval_window(spec: SloSpec, before: dict | None, after: dict):
         num = counter_delta(before, after, spec.metric)
         den = counter_delta(before, after, spec.per)
         return None if den <= 0 else num / den
+    if spec.kind == "gauge_growth":
+        secs = _window_seconds(before, after)
+        if secs <= 0.0 or before is None:
+            return None
+        a = after.get("gauges", {}).get(spec.metric)
+        b = before.get("gauges", {}).get(spec.metric)
+        if a is None or b is None:
+            return None
+        return (a - b) / secs
     # gauge_max: worst value across the window's endpoints.
     values = [
         s.get("gauges", {}).get(spec.metric)

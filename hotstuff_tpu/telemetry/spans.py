@@ -35,6 +35,7 @@ from __future__ import annotations
 import time
 from collections import OrderedDict
 
+from . import profiler as pyprof
 from .registry import DURATION_MS_BUCKETS, FINE_DURATION_MS_BUCKETS, Registry
 
 _PROPOSE, _VOTE, _QC = 0, 1, 2
@@ -99,7 +100,15 @@ class RoundTrace:
             marks = self._rounds[round_] = [None, None, None]
         return marks
 
+    # Each mark flips the sampling profiler's per-thread stage tag to the
+    # edge whose work FOLLOWS the mark (profiler samples between two
+    # marks get blamed on the edge between them — the join key
+    # benchmark/profile_assemble.py uses against the trace edges). One
+    # module-attribute read per mark when no profiler session is live.
+
     def mark_propose(self, round_: int) -> None:
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("verify")
         marks = self._marks(round_)
         if marks[_PROPOSE] is None:
             marks[_PROPOSE] = t = time.perf_counter()
@@ -109,19 +118,27 @@ class RoundTrace:
         """The proposal's certificates passed verification on this node
         (event-only: the cross-node assembler attributes the
         receive→verified edge; there is no local histogram)."""
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("vote")
         self._emit(round_, "verified", time.perf_counter())
 
     def mark_vote_send(self, round_: int) -> None:
         """This node created and dispatched its vote (event-only)."""
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("idle")
         self._emit(round_, "vote_send", time.perf_counter())
 
     def mark_vote(self, round_: int) -> None:
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("fanin")
         marks = self._marks(round_)
         if marks[_VOTE] is None:
             marks[_VOTE] = t = time.perf_counter()
             self._emit(round_, "first_vote", t)
 
     def mark_qc(self, round_: int) -> None:
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("qc_to_commit")
         marks = self._marks(round_)
         if marks[_QC] is None:
             marks[_QC] = t = time.perf_counter()
@@ -134,6 +151,8 @@ class RoundTrace:
     def mark_commit(self, round_: int) -> None:
         """Close round ``round_`` (and GC every older round: commits are
         monotone, so anything below the committed round is finished)."""
+        if pyprof.TAGGING:
+            pyprof.set_thread_stage("idle")
         now = time.perf_counter()
         marks = self._rounds.get(round_)
         self._emit(round_, "commit", now)

@@ -1,0 +1,223 @@
+"""Profile⇄trace join tests: per-edge function attribution from
+synthetic streams, speedscope export, and the trace assembler's
+missing-anchor warn-and-continue contract."""
+
+from __future__ import annotations
+
+import json
+
+import pytest
+
+from benchmark.profile_assemble import (
+    aggregate,
+    attribute,
+    load_profiles,
+    to_speedscope,
+    top_functions,
+)
+from benchmark.trace_assemble import assemble, load_events
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.telemetry import TraceBuffer, build_trace_record
+from hotstuff_tpu.telemetry.profiler import PROFILE_SCHEMA
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _trace_record(node, events, anchor_mono=0.0, anchor_wall=1000.0):
+    buf = TraceBuffer(capacity=1024)
+    buf.anchor_mono = anchor_mono
+    buf.anchor_wall = anchor_wall
+    return build_trace_record(buf, events, node=node)
+
+
+def _profile_record(node, stacks, seq=0, samples=None, ctypes=None):
+    return {
+        "schema": PROFILE_SCHEMA,
+        "node": node,
+        "pid": 1,
+        "seq": seq,
+        "ts": 1000.0,
+        "mode": "thread",
+        "interval_ms": 2.0,
+        "samples": (
+            samples if samples is not None else sum(c for _s, _f, c in stacks)
+        ),
+        "truncated": 0,
+        "threads": 1,
+        "gil_delay_ns": 1_000_000,
+        "ctypes": ctypes or {},
+        "stacks": stacks,
+    }
+
+
+def _round_events(node, r, base, *, leader=False, collector=False):
+    seq = r * 100 + hash(node) % 50
+    events = []
+    if leader:
+        events.append((seq + 1, node, r, "propose_send", base))
+    events.append((seq + 2, node, r, "propose", base + 0.002))
+    events.append((seq + 3, node, r, "verified", base + 0.004))
+    events.append((seq + 4, node, r, "vote_send", base + 0.005))
+    if collector:
+        events.append((seq + 5, node, r, "first_vote", base + 0.007))
+        events.append((seq + 6, node, r, "qc", base + 0.010))
+    events.append((seq + 7, node, r, "commit", base + 0.030))
+    return events
+
+
+def _write_joined_stream(path, node, *, leader=False, collector=False):
+    """A stream carrying trace AND profile records, like a real node's."""
+    events = []
+    for r in (1, 2):
+        events += _round_events(
+            node, r, r * 0.1, leader=leader, collector=collector
+        )
+    stacks = [
+        ["ingress", "a.py:1:loop;serde.py:5:decode_message", 30],
+        ["ingress", "a.py:1:loop;serde.py:9:decode_qc", 10],
+        ["verify", "a.py:1:loop;crypto.py:7:verify_batch", 25],
+        ["idle", "a.py:1:loop;selectors.py:2:select", 100],
+    ]
+    lines = [
+        json.dumps(_trace_record(node, events)),
+        json.dumps(
+            _profile_record(
+                node,
+                stacks,
+                ctypes={"hs_net.hs_net_send": [40, 2_000_000]},
+            )
+        ),
+    ]
+    path.write_text("\n".join(lines) + "\n")
+    return str(path)
+
+
+def test_attribute_joins_top_functions_onto_edges(tmp_path):
+    paths = [
+        _write_joined_stream(tmp_path / "telemetry-n0.jsonl", "n0", leader=True),
+        _write_joined_stream(
+            tmp_path / "telemetry-n1.jsonl", "n1", collector=True
+        ),
+    ]
+    report = attribute(paths)
+    assert report["rounds"] == 2
+    ingress = report["edges"]["ingress"]
+    # Trace side of the join: the edge's measured milliseconds.
+    assert ingress["trace_mean_ms"] == pytest.approx(2.0, abs=0.5)
+    # Profile side: top functions by self samples, both nodes summed.
+    assert ingress["samples"] == 80
+    top = ingress["top_functions"]
+    assert top[0]["fn"] == "serde.py:5:decode_message"
+    assert top[0]["self_samples"] == 60
+    assert top[0]["self_share"] == pytest.approx(0.75)
+    assert top[0]["self_ms_est"] == pytest.approx(120.0)
+    verify = report["edges"]["verify"]
+    assert verify["top_functions"][0]["fn"] == "crypto.py:7:verify_batch"
+    # Stages without a trace edge are reported, not joined.
+    assert report["other_stages"]["idle"]["samples"] == 200
+    # Boundary accounts survive the merge (per-session cumulative).
+    assert report["ctypes"]["hs_net.hs_net_send"]["calls"] == 80
+    assert report["sampler"]["gil_delay_ms"] == pytest.approx(2.0)
+
+
+def test_aggregate_keeps_last_record_per_session():
+    recs = [
+        _profile_record("n0", [["verify", "a;b", 5]], seq=0, samples=5),
+        # Same session later: cumulative samples grow; stacks are deltas.
+        _profile_record("n0", [["verify", "a;b", 3]], seq=1, samples=8),
+    ]
+    stages, meta = aggregate(recs)
+    assert stages["verify"]["a;b"] == 8  # deltas sum
+    assert meta["samples"] == 8  # cumulative: last record wins
+
+
+def test_top_functions_orders_by_self_time():
+    from collections import Counter
+
+    stacks = Counter({"a;b;c": 10, "a;b": 5, "a;d": 1})
+    top = top_functions(stacks, 2.0, 2)
+    assert [t["fn"] for t in top] == ["c", "b"]
+    assert top[0]["cum_samples"] == 10
+    assert top[1]["cum_samples"] == 15  # b is on two stacks
+
+
+def test_speedscope_export_shape(tmp_path):
+    paths = [
+        _write_joined_stream(tmp_path / "telemetry-n0.jsonl", "n0", leader=True)
+    ]
+    stages, meta = aggregate(load_profiles(paths))
+    scope = to_speedscope(stages, meta["interval_ms"], "test")
+    assert scope["$schema"].startswith("https://www.speedscope.app")
+    names = {p["name"] for p in scope["profiles"]}
+    assert {"ingress", "verify", "idle"} <= names
+    frames = scope["shared"]["frames"]
+    for profile in scope["profiles"]:
+        assert profile["type"] == "sampled"
+        assert len(profile["samples"]) == len(profile["weights"])
+        assert profile["endValue"] == pytest.approx(sum(profile["weights"]))
+        for sample in profile["samples"]:
+            for idx in sample:
+                assert 0 <= idx < len(frames)
+    idle = next(p for p in scope["profiles"] if p["name"] == "idle")
+    assert sum(idle["weights"]) == pytest.approx(100 * 2.0)
+
+
+def test_attribute_without_profiles_reports_zero_samples(tmp_path):
+    path = tmp_path / "telemetry-n0.jsonl"
+    path.write_text(
+        json.dumps(
+            _trace_record("n0", _round_events("n0", 1, 0.1, leader=True))
+        )
+        + "\n"
+    )
+    report = attribute([str(path)])
+    assert report["sampler"]["samples"] == 0
+    assert all(e["samples"] == 0 for e in report["edges"].values())
+
+
+# -- trace assembler: missing-anchor warn-and-continue ------------------------
+
+
+def test_missing_anchor_stream_is_skipped_and_counted(tmp_path, capsys):
+    good = _write_joined_stream(
+        tmp_path / "telemetry-n0.jsonl", "n0", leader=True
+    )
+    # n1's record lost its anchor (e.g. a hand-rolled emitter): the node
+    # is skipped with a warning, the rest of the committee assembles.
+    rec = _trace_record("n1", _round_events("n1", 1, 0.1, collector=True))
+    del rec["anchor"]
+    bad = tmp_path / "telemetry-n1.jsonl"
+    bad.write_text(json.dumps(rec) + "\n")
+
+    report = assemble([good, str(bad)])
+    assert report["rounds"] == 2  # n0's rounds still assembled
+    assert report["skipped_streams"] == ["telemetry-n1.jsonl"]
+    err = capsys.readouterr().err
+    assert "telemetry-n1" in err and "anchor" in err
+
+
+def test_anchorless_record_skips_only_that_stream(tmp_path):
+    rec = _trace_record("n2", _round_events("n2", 1, 0.1))
+    rec["anchor"] = {"mono": "not-a-number", "wall": None}
+    path = tmp_path / "telemetry-n2.jsonl"
+    path.write_text(json.dumps(rec) + "\n")
+    skipped: list[str] = []
+    events = load_events([str(path)], skipped_streams=skipped)
+    assert events == []
+    assert skipped == ["telemetry-n2.jsonl"]
+
+
+def test_corrupt_stream_warns_and_continues(tmp_path):
+    good = _write_joined_stream(
+        tmp_path / "telemetry-n0.jsonl", "n0", leader=True
+    )
+    bad = tmp_path / "telemetry-n1.jsonl"
+    bad.write_text('{"schema": "hotstuff-trace-v1"}\nnot json at all\n')
+    report = assemble([good, str(bad)])
+    assert report["rounds"] == 2
+    assert report["skipped_streams"] == ["telemetry-n1.jsonl"]

@@ -226,3 +226,54 @@ def test_spec_validation_and_io(tmp_path):
     path.write_text(json.dumps([s.to_dict() for s in specs]))
     loaded = slo.load_specs(str(path))
     assert [s.to_dict() for s in loaded] == [s.to_dict() for s in specs]
+
+
+# -- gauge_growth (memory-growth SLOs) ---------------------------------------
+
+
+def test_gauge_growth_judges_per_second_slope():
+    spec = slo.SloSpec(
+        "rss_growth", "gauge_growth", "resource.rss_bytes", max=1_000.0
+    )
+    snaps = [
+        _snap(0, gauges={"resource.rss_bytes": 100_000}),
+        _snap(10, gauges={"resource.rss_bytes": 105_000}),  # 500 B/s: ok
+        _snap(20, gauges={"resource.rss_bytes": 205_000}),  # 10 kB/s: bad
+    ]
+    verdict = slo.evaluate(snaps, [spec], window_s=5.0)
+    assert verdict["ok"] is False
+    result = verdict["slos"][0]
+    assert result["windows"] == 2
+    assert result["violated_windows"] == 1
+    assert result["worst"] == pytest.approx(10_000.0)
+
+
+def test_gauge_growth_negative_growth_passes():
+    # Compaction/GC shrinks the gauge: a max bound never fires.
+    spec = slo.SloSpec(
+        "store_growth", "gauge_growth", "resource.store_bytes", max=100.0
+    )
+    snaps = [
+        _snap(0, gauges={"resource.store_bytes": 1_000_000}),
+        _snap(10, gauges={"resource.store_bytes": 200_000}),
+    ]
+    verdict = slo.evaluate(snaps, [spec], window_s=5.0)
+    assert verdict["ok"] is True
+
+
+def test_gauge_growth_absent_gauge_skips_windows():
+    # A node without the resource collector: no data, not a violation.
+    spec = slo.SloSpec(
+        "rss_growth", "gauge_growth", "resource.rss_bytes", max=1.0
+    )
+    snaps = [_snap(0), _snap(10), _snap(20)]
+    verdict = slo.evaluate(snaps, [spec], window_s=5.0)
+    assert verdict["ok"] is True
+    assert verdict["slos"][0]["windows"] == 0
+
+
+def test_memory_slos_default_set():
+    specs = slo.memory_slos()
+    names = [s.name for s in specs]
+    assert names == ["rss_growth_bytes_per_s", "store_growth_bytes_per_s"]
+    assert all(s.kind == "gauge_growth" for s in specs)
