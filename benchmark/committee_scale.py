@@ -117,6 +117,15 @@ async def run_committee(
         # must judge the measured regime (boot counters still appear —
         # cumulatively — in the first snapshot's totals, just never as a
         # window delta).
+        #
+        # The round-trace ring gets the same anchoring: boot-era events
+        # would otherwise drain into the measured stream, and a round
+        # whose timeline spans both lives (proposed during dial-in,
+        # commit-straggled by a lagging engine into the measured window)
+        # reports a multi-minute "critical path" that is really boot
+        # skew — observed live at N=200, poisoning the committed
+        # trace-edge means by two orders of magnitude.
+        telemetry.trace_buffer().clear()
         emitter.emit()
         emitter.spawn()
     registry = telemetry.get_registry()

@@ -9,6 +9,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import os
 
 from hotstuff_tpu.crypto import PublicKey, SignatureService
 from hotstuff_tpu.network import MessageHandler, Receiver
@@ -18,11 +19,12 @@ from hotstuff_tpu.utils.serde import SerdeError
 
 from .config import Committee, Parameters
 from .core import Core
+from .decode_arena import decode_shared
 from .errors import MalformedMessage
 from .helper import Helper
 from .leader import make_elector
 from .mempool_driver import MempoolDriver
-from .messages import decode_message, decode_vote_frame
+from .messages import SeatTable, decode_vote_frame
 from .proposer import Proposer
 from .synchronizer import Synchronizer
 
@@ -32,9 +34,19 @@ CHANNEL_CAPACITY = 1_000
 
 
 class ConsensusReceiverHandler(MessageHandler):
-    def __init__(self, tx_consensus: asyncio.Queue, tx_helper: asyncio.Queue) -> None:
+    def __init__(
+        self,
+        tx_consensus: asyncio.Queue,
+        tx_helper: asyncio.Queue,
+        seats: SeatTable | None = None,
+    ) -> None:
         self.tx_consensus = tx_consensus
         self.tx_helper = tx_helper
+        # Seat table for wire-format v2 certificate sections. Decoding
+        # accepts BOTH formats whenever the table is known — acceptance
+        # is not what the wire_v2 parameter gates (that only selects what
+        # we emit), so a mixed v1/v2 committee interoperates.
+        self.seats = seats
 
     async def dispatch(self, writer, serialized: bytes) -> None:
         if pyprof.TAGGING:
@@ -43,7 +55,12 @@ class ConsensusReceiverHandler(MessageHandler):
             # it so the sampler blames decode frames on ingress.
             pyprof.set_thread_stage("ingress")
         try:
-            kind, payload = decode_message(serialized)
+            # Shared decode arena: a broadcast frame (proposal/timeout/
+            # TC) fanned to N in-process engines — or retransmitted
+            # byte-identically during a view change — parses once
+            # process-wide; every other arrival is a content-addressed
+            # hit handing back the same immutable decoded view.
+            kind, payload = decode_shared(serialized, self.seats)
         except (SerdeError, MalformedMessage, ValueError) as e:
             log.warning("failed to decode consensus message: %s", e)
             return
@@ -105,6 +122,17 @@ class Consensus:
         tx_proposer: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
         tx_helper: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
 
+        seats = SeatTable.for_committee(committee)
+        # wire_v2 selects what WE emit; decode always accepts both (the
+        # seat table above), so flipping this per node cannot split a
+        # committee — that is the whole negotiation story.
+        wire_seats = (
+            seats
+            if parameters.wire_v2
+            and os.environ.get("HOTSTUFF_WIRE_V2", "1") != "0"
+            else None
+        )
+
         address = committee.address(name)
         assert address is not None, "our public key is not in the committee"
         # auto_ack: the transport ACKs on frame arrival — the leader's
@@ -115,7 +143,7 @@ class Consensus:
         # are harmless.
         receiver = await Receiver.spawn(
             ("0.0.0.0", address[1]),
-            ConsensusReceiverHandler(tx_consensus, tx_helper),
+            ConsensusReceiverHandler(tx_consensus, tx_helper, seats),
             auto_ack=True,
         )
         self.receivers.append(receiver)
@@ -157,6 +185,7 @@ class Consensus:
                 batch_vote_verification=parameters.batch_vote_verification,
                 on_round_advance=on_round_advance,
                 profile=profile,
+                wire_seats=wire_seats,
             )
         )
         self.tasks.append(
@@ -168,6 +197,7 @@ class Consensus:
                 tx_proposer,
                 tx_loopback,
                 benchmark=benchmark,
+                wire_seats=wire_seats,
             )
         )
         self.tasks.append(Helper.spawn(committee, store, tx_helper))

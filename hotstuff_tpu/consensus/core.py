@@ -72,6 +72,7 @@ class Core:
     _m_qcs = _m_tcs = _m_rounds = _m_blocks = telemetry.NULL_COUNTER
     _g_round = _g_committed_round = telemetry.NULL_GAUGE
     _trace = None
+    _wire_seats = None  # state-only instances broadcast legacy v1
 
     def __init__(
         self,
@@ -92,6 +93,7 @@ class Core:
         batch_vote_verification: bool = False,
         on_round_advance=None,
         profile: bool = False,
+        wire_seats=None,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -132,6 +134,9 @@ class Core:
         # C++ vote pre-stage so its stale-round cutoff tracks the core's.
         # None on the asyncio transport.
         self._on_round_advance = on_round_advance
+        # Wire-format v2 seat table for outgoing timeout/TC broadcasts
+        # (None = emit legacy v1). Decode-side acceptance is unconditional.
+        self._wire_seats = wire_seats
         # Optional per-stage profiling (benchmark --profile): one
         # perf_counter_ns pair per handled event, accumulated into the
         # telemetry registry as ``consensus.stage.<kind>.{ns,calls}``
@@ -296,7 +301,9 @@ class Core:
         log.debug("Created %r", timeout)
         self.timer.reset()
         addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
-        self.network.broadcast(addresses, encode_timeout(timeout))
+        self.network.broadcast(
+            addresses, encode_timeout(timeout, self._wire_seats)
+        )
         await self.handle_timeout(timeout)
 
     # -- handlers -----------------------------------------------------------
@@ -388,7 +395,7 @@ class Core:
             return None
         try:
             await verify_off_loop(
-                qc.verify, self.committee, self._cert_cache, n_sigs=len(qc.votes)
+                qc.verify, self.committee, self._cert_cache, n_sigs=qc.n_votes()
             )
             return qc
         except BackendUnavailable as e:
@@ -435,7 +442,7 @@ class Core:
             return  # the protocol moved on
         try:
             await verify_off_loop(
-                qc.verify, self.committee, self._cert_cache, n_sigs=len(qc.votes)
+                qc.verify, self.committee, self._cert_cache, n_sigs=qc.n_votes()
             )
         except BackendUnavailable:
             self._schedule_qc_retry(qc, attempt + 1)
@@ -540,7 +547,7 @@ class Core:
                 return
         hq = timeout.high_qc
         n_sigs = 1 + (
-            0 if hq == QC.genesis() else self._effective_sigs(hq, len(hq.votes))
+            0 if hq == QC.genesis() else self._effective_sigs(hq, hq.n_votes())
         )
         await verify_off_loop(
             timeout.verify, self.committee, self._cert_cache, n_sigs=n_sigs
@@ -552,7 +559,7 @@ class Core:
             self._m_tcs.inc()
             await self.advance_round(tc.round, via_tc=True)
             addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
-            self.network.broadcast(addresses, encode_tc(tc))
+            self.network.broadcast(addresses, encode_tc(tc, self._wire_seats))
             if self.name == self.leader_elector.get_leader(self.round):
                 await self.generate_proposal(tc)
         elif timeout.round > self.round:
@@ -594,7 +601,9 @@ class Core:
         )
         self.timer.reset()
         addresses = [a for _, a in self.committee.broadcast_addresses(self.name)]
-        self.network.broadcast(addresses, encode_timeout(timeout))
+        self.network.broadcast(
+            addresses, encode_timeout(timeout, self._wire_seats)
+        )
         await self.handle_timeout(timeout)
 
     async def advance_round(self, round_: Round, via_tc: bool = False) -> None:
@@ -716,9 +725,9 @@ class Core:
                 )
         n_sigs = 1
         if block.qc != QC.genesis():
-            n_sigs += self._effective_sigs(block.qc, len(block.qc.votes))
+            n_sigs += self._effective_sigs(block.qc, block.qc.n_votes())
         if block.tc is not None:
-            n_sigs += self._effective_sigs(block.tc, len(block.tc.votes))
+            n_sigs += self._effective_sigs(block.tc, block.tc.n_votes())
         await verify_off_loop(
             block.verify, self.committee, self._cert_cache, n_sigs=n_sigs
         )
@@ -774,7 +783,7 @@ class Core:
             tc.verify,
             self.committee,
             self._cert_cache,
-            n_sigs=self._effective_sigs(tc, len(tc.votes)),
+            n_sigs=self._effective_sigs(tc, tc.n_votes()),
         )
         if tc.round < self.round:
             return

@@ -1,0 +1,149 @@
+"""Shared decode arena: parse each distinct consensus frame exactly once
+per process.
+
+Why: in the one-process committee testbed every broadcast frame — a
+proposal carrying a 2f+1-signature QC, a view-change timeout carrying the
+same high_qc, a TC — is delivered to N engines and was parsed N times,
+once per engine. The PR 7 profile named that loop as the N=200 ingress
+wall at function level: ``serde.raw`` 30%, ``Signature/PublicKey.__init__``
+18%, ``serde._take`` 15% of the edge. The codec is deterministic and the
+decoded objects are immutable by construction (blocks/QCs/TCs are never
+mutated after decode; memo attributes are idempotent), so byte-identical
+frames decode to interchangeable views — the arena hands every engine a
+zero-copy reference to ONE shared decode.
+
+This is pure memoization of a deterministic function, so — unlike the
+per-node ``CertificateCache``, which models *verification work* a real
+distributed node must pay itself — a process-wide arena does not let one
+node skip work another paid for in any way that matters to the modeled
+deployment: a multi-process deployment simply sees fewer hits (rebroadcast
+timeouts/TCs still repeat byte-identically within one process and still
+win).
+
+Only broadcast-shaped kinds are cached (``propose``, ``timeout``, ``tc``).
+Votes travel point-to-point (unique per author) and sync requests are
+trivial — caching them would only grow the table. Failed parses are NOT
+cached: malformed frames re-raise on every arrival, byte-for-byte the
+behavior of the per-engine decoder.
+
+Keyed by (seat-table fingerprint, frame bytes): the same bytes decoded
+under different committees (tests) must not alias. Bounded by entries AND
+bytes with LRU eviction. ``HOTSTUFF_DECODE_ARENA=0`` disables the arena
+(every call falls through to a fresh decode) for A/B runs and the
+equivalence tests.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+from collections import OrderedDict
+
+from hotstuff_tpu import telemetry
+
+from .messages import SeatTable, decode_message
+
+_CACHEABLE = frozenset(("propose", "timeout", "tc"))
+
+
+class DecodeArena:
+    """Content-addressed cache of decoded consensus frames."""
+
+    def __init__(self, max_entries: int = 2048, max_bytes: int = 64 << 20) -> None:
+        self.max_entries = max_entries
+        self.max_bytes = max_bytes
+        self.hits = 0
+        self.misses = 0
+        self.bytes_saved = 0
+        self._bytes = 0
+        # (fingerprint, frame) -> (kind, payload, nbytes)
+        self._entries: "OrderedDict[tuple, tuple]" = OrderedDict()
+        # Decodes run on the event loop today, but the arena is
+        # process-wide state and one uncontended lock acquisition is
+        # noise next to a frame parse.
+        self._lock = threading.Lock()
+        self._metrics_live = None  # refreshed when telemetry flips on/off
+
+    def _metrics(self):
+        # The arena outlives telemetry.enable() (module singleton), so
+        # metric objects are re-fetched whenever the enabled state flips
+        # instead of being captured once at import.
+        live = telemetry.enabled()
+        if live != self._metrics_live:
+            self._metrics_live = live
+            self._m_hits = telemetry.counter("consensus.arena.hits")
+            self._m_misses = telemetry.counter("consensus.arena.misses")
+            self._m_saved = telemetry.counter("consensus.arena.bytes_saved")
+            self._m_evict = telemetry.counter("consensus.arena.evictions")
+        return self._m_hits, self._m_misses, self._m_saved, self._m_evict
+
+    def decode(self, data: bytes, seats: SeatTable | None = None):
+        m_hits, m_misses, m_saved, m_evict = self._metrics()
+        key = (seats.fingerprint if seats is not None else None, bytes(data))
+        with self._lock:
+            entry = self._entries.get(key)
+            if entry is not None:
+                self._entries.move_to_end(key)
+                self.hits += 1
+                self.bytes_saved += entry[2]
+                m_hits.inc()
+                m_saved.inc(entry[2])
+                return entry[0], entry[1]
+        kind, payload = decode_message(data, seats)
+        with self._lock:
+            self.misses += 1
+            m_misses.inc()
+            if kind in _CACHEABLE and key not in self._entries:
+                nbytes = len(key[1])
+                self._entries[key] = (kind, payload, nbytes)
+                self._bytes += nbytes
+                while self._entries and (
+                    len(self._entries) > self.max_entries
+                    or self._bytes > self.max_bytes
+                ):
+                    _, (_, _, evicted) = self._entries.popitem(last=False)
+                    self._bytes -= evicted
+                    m_evict.inc()
+        return kind, payload
+
+    def stats(self) -> dict[str, int]:
+        with self._lock:
+            return {
+                "entries": len(self._entries),
+                "bytes": self._bytes,
+                "hits": self.hits,
+                "misses": self.misses,
+                "bytes_saved": self.bytes_saved,
+            }
+
+    def clear(self) -> None:
+        with self._lock:
+            self._entries.clear()
+            self._bytes = 0
+
+
+_ENABLED = os.environ.get("HOTSTUFF_DECODE_ARENA", "1") != "0"
+_ARENA = DecodeArena()
+
+# Gauge collector: entry count / resident bytes surface in snapshots
+# without a per-decode gauge write.
+telemetry.register_collector(
+    "consensus.arena",
+    lambda: {"entries": len(_ARENA._entries), "bytes": _ARENA._bytes},
+)
+
+
+def arena() -> DecodeArena:
+    return _ARENA
+
+
+def enabled() -> bool:
+    return _ENABLED
+
+
+def decode_shared(data: bytes, seats: SeatTable | None = None):
+    """Arena-backed :func:`decode_message`; identical results and
+    identical exceptions, minus the redundant re-parses."""
+    if not _ENABLED:
+        return decode_message(data, seats)
+    return _ARENA.decode(data, seats)

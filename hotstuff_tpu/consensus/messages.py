@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import struct
 import threading
+from collections import OrderedDict
 from dataclasses import dataclass
 
 from hotstuff_tpu.crypto import (
@@ -25,13 +26,15 @@ from hotstuff_tpu.crypto import (
     PublicKey,
     SecretKey,
     Signature,
+    get_backend,
     sha512_digest,
 )
-from hotstuff_tpu.utils.serde import Decoder, Encoder
+from hotstuff_tpu.utils.serde import MAX_LEN, Decoder, Encoder, SerdeError
 
 from . import errors
 from .config import Committee, Round
 
+_U32 = struct.Struct("<I")
 _U64 = struct.Struct("<Q")
 
 # Decoded public keys interned by raw bytes: the same ~N committee keys
@@ -40,16 +43,102 @@ _U64 = struct.Struct("<Q")
 # copy, re-hash on every dict lookup — was a top CPU line of the N=100
 # protocol bench. Interning also makes dict/set lookups hit CPython's
 # identity fast path and reuses the cached bytes hash.
-_PK_INTERN: dict[bytes, "PublicKey"] = {}
+#
+# Bounded as a true LRU: the previous clear-at-cap policy dumped the
+# whole table — including every live committee key — whenever a
+# byzantine spray (or a long soak across key rotations) filled it,
+# re-paying N constructions per subsequent certificate. Eviction now
+# drops only the coldest entry; committee keys are touched on every
+# decode and never age out. Evictions are counted (``intern_evictions``)
+# so soaks can see rotation/spray pressure.
+_PK_INTERN_CAP = 4096
+_PK_INTERN: "OrderedDict[bytes, PublicKey]" = OrderedDict()
+intern_evictions = 0
 
 
 def _intern_pk(raw: bytes) -> PublicKey:
     pk = _PK_INTERN.get(raw)
     if pk is None:
-        if len(_PK_INTERN) >= 4096:  # byzantine spray bound; committees are small
-            _PK_INTERN.clear()
+        if len(_PK_INTERN) >= _PK_INTERN_CAP:
+            global intern_evictions
+            _PK_INTERN.popitem(last=False)
+            intern_evictions += 1
+            from hotstuff_tpu import telemetry
+
+            telemetry.counter("consensus.intern_pk.evictions").inc()
         pk = _PK_INTERN[raw] = PublicKey(raw)
+    else:
+        _PK_INTERN.move_to_end(raw)
     return pk
+
+
+# ---------------------------------------------------------------------------
+# Seat table: canonical committee numbering for wire-format v2.
+# ---------------------------------------------------------------------------
+
+
+class SeatTable:
+    """Canonical seat numbering of a committee: seat ``i`` is the ``i``-th
+    public key in sorted order — the same deterministic order on every
+    node, so a certificate can name its signers as a BITMAP of seats
+    instead of repeating each 32-byte key on the wire (wire-format v2,
+    ~33% smaller proposals at N=200). Keys are interned, so mapping a
+    seat back to its PublicKey is a list index — no per-vote decode."""
+
+    __slots__ = ("keys", "index", "nbytes", "fingerprint")
+
+    def __init__(self, keys) -> None:
+        self.keys: list[PublicKey] = [_intern_pk(bytes(pk)) for pk in keys]
+        self.index: dict[PublicKey, int] = {
+            pk: i for i, pk in enumerate(self.keys)
+        }
+        self.nbytes = (len(self.keys) + 7) // 8  # bitmap width
+        self.fingerprint = sha512_digest(*[pk.data for pk in self.keys]).data
+
+    def __len__(self) -> int:
+        return len(self.keys)
+
+    @classmethod
+    def for_committee(cls, committee: Committee) -> "SeatTable":
+        """Memoized on the committee object (committees are static per
+        epoch; an epoch change builds a new Committee and thus a new
+        table)."""
+        table = committee.__dict__.get("_seat_table")
+        if table is None:
+            table = cls(committee.sorted_keys())
+            committee.__dict__["_seat_table"] = table
+        return table
+
+
+# Wire-format v2 marker: set on the vote-count u32 of a QC/TC vote
+# section. v1 counts are bounded by MAX_LEN (< 2^26), so the bit is
+# unambiguous. Layout after a flagged count (in ascending seat order):
+#   QC: bitmap[seats.nbytes] | count * 64B signature
+#   TC: bitmap[seats.nbytes] | count * (64B signature + u64 high_qc_round)
+_V2_FLAG = 0x8000_0000
+
+
+def _bitmap_seats(bitmap: bytes, n_seats: int) -> list[int]:
+    """Ascending seat indices set in ``bitmap``; rejects bits >= n_seats."""
+    seats: list[int] = []
+    for byte_i, byte in enumerate(bitmap):
+        if not byte:
+            continue
+        base = byte_i * 8
+        for bit in range(8):
+            if byte & (1 << bit):
+                seat = base + bit
+                if seat >= n_seats:
+                    raise SerdeError(f"v2 bitmap names unknown seat {seat}")
+                seats.append(seat)
+    return seats
+
+
+def _seats_bitmap(seat_indices, nbytes: int) -> bytes:
+    out = bytearray(nbytes)
+    for s in seat_indices:
+        out[s >> 3] |= 1 << (s & 7)
+    return bytes(out)
 
 
 class CertificateCache:
@@ -94,12 +183,14 @@ class CertificateCache:
         # QC/TC.verify — one encode instead of two per certificate, and
         # zero for repeats. Certificates are never mutated after
         # construction (ejection builds new QC objects), so the memo
-        # cannot go stale.
+        # cannot go stale. The key is always the CANONICAL (v1) encoding
+        # regardless of the wire format the certificate arrived in, so a
+        # high_qc received v1 from one peer and v2 from another hits the
+        # same entry; lazily-decoded v2 certificates assemble it from
+        # raw slices without materializing Signature objects.
         key = cert.__dict__.get("_cache_key")
         if key is None:
-            enc = Encoder()
-            cert.encode(enc)
-            key = bytes(enc.finish())
+            key = cert._canonical_key()
             cert._cache_key = key
         return key
 
@@ -143,6 +234,62 @@ class QC:
             and self.round == other.round
         )
 
+    # -- lazy votes (wire-format v2 decode) --
+    #
+    # A v2-decoded QC holds ``_raw_votes = (seat_indices, sig_buf, seats)``
+    # instead of materialized ``votes``: the verify path consumes raw
+    # 64-byte slices of ``sig_buf`` directly and a cache-hit QC never
+    # constructs a Signature at all. ``votes`` materializes on first
+    # attribute access (idempotent — a benign race between crypto worker
+    # threads builds the same list twice and one wins).
+
+    def __getattr__(self, name):
+        if name == "votes":
+            raw = self.__dict__.get("_raw_votes")
+            if raw is not None:
+                seat_list, sig_buf, seats = raw
+                keys = seats.keys
+                votes = [
+                    (keys[s], Signature(sig_buf[i * 64 : i * 64 + 64]))
+                    for i, s in enumerate(seat_list)
+                ]
+                self.__dict__["votes"] = votes
+                return votes
+        raise AttributeError(name)
+
+    def n_votes(self) -> int:
+        """Vote count without materializing lazy votes (sig-count input
+        to the verify-offload policy)."""
+        votes = self.__dict__.get("votes")
+        if votes is not None:
+            return len(votes)
+        raw = self.__dict__.get("_raw_votes")
+        return len(raw[0]) if raw is not None else len(self.votes)
+
+    def _canonical_key(self) -> bytes:
+        raw = None
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+        if raw is not None:
+            # v1-canonical bytes assembled straight from the arena
+            # slices — no Signature/PublicKey construction.
+            seat_list, sig_buf, seats = raw
+            keys = seats.keys
+            return b"".join(
+                (
+                    self.hash.data,
+                    _U64.pack(self.round),
+                    _U32.pack(len(seat_list)),
+                    *(
+                        keys[s].data + sig_buf[i * 64 : i * 64 + 64]
+                        for i, s in enumerate(seat_list)
+                    ),
+                )
+            )
+        enc = Encoder()
+        self.encode(enc)
+        return bytes(enc.finish())
+
     def verify(
         self, committee: Committee, cache: "CertificateCache | None" = None
     ) -> None:
@@ -154,37 +301,122 @@ class QC:
             key = CertificateCache.key_of(self)
             if cache.hit(key):
                 return
+        raw = None
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+        if raw is not None:
+            self._verify_raw(committee, raw)
+        else:
+            weight = 0
+            used = set()
+            for name, _ in self.votes:
+                if name in used:
+                    raise errors.AuthorityReuse(str(name))
+                stake = committee.stake(name)
+                if stake == 0:
+                    raise errors.UnknownAuthority(str(name))
+                used.add(name)
+                weight += stake
+            if weight < committee.quorum_threshold():
+                raise errors.QCRequiresQuorum("QC requires a quorum")
+            try:
+                Signature.verify_batch(self.digest(), self.votes)
+            except BackendUnavailable:
+                raise  # infrastructure failure, NOT a byzantine signature
+            except CryptoError as e:
+                raise errors.InvalidSignature(str(e)) from e
+        if cache is not None:
+            cache.add(key)
+
+    def _verify_raw(self, committee: Committee, raw) -> None:
+        """Raw-slice verification of a lazily-decoded v2 QC: identical
+        acceptance to the materialized path (the bitmap decode already
+        guarantees distinct seats, so AuthorityReuse cannot arise), but
+        the crypto plane consumes 64-byte slices of the arena buffer —
+        no Signature objects on the hot path."""
+        seat_list, sig_buf, seats = raw
+        keys = seats.keys
         weight = 0
-        used = set()
-        for name, _ in self.votes:
-            if name in used:
-                raise errors.AuthorityReuse(str(name))
-            stake = committee.stake(name)
+        for s in seat_list:
+            stake = committee.stake(keys[s])
             if stake == 0:
-                raise errors.UnknownAuthority(str(name))
-            used.add(name)
+                raise errors.UnknownAuthority(str(keys[s]))
             weight += stake
         if weight < committee.quorum_threshold():
             raise errors.QCRequiresQuorum("QC requires a quorum")
+        digest = self.digest()
         try:
-            Signature.verify_batch(self.digest(), self.votes)
+            get_backend().verify_batch(
+                [digest.data] * len(seat_list),
+                [keys[s].data for s in seat_list],
+                [sig_buf[i * 64 : i * 64 + 64] for i in range(len(seat_list))],
+            )
         except BackendUnavailable:
             raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
-        if cache is not None:
-            cache.add(key)
 
-    def encode(self, enc: Encoder) -> None:
-        enc.raw(self.hash.data).u64(self.round).seq(
-            self.votes, lambda e, v: e.raw(v[0].data).raw(v[1].data)
-        )
+    def encode(self, enc: Encoder, seats: "SeatTable | None" = None) -> None:
+        enc.raw(self.hash.data).u64(self.round)
+        if seats is not None and self._encode_votes_v2(enc, seats):
+            return
+        enc.seq(self.votes, lambda e, v: e.raw(v[0].data).raw(v[1].data))
+
+    def _encode_votes_v2(self, enc: Encoder, seats: "SeatTable") -> bool:
+        raw = None
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+        if raw is not None and raw[2] is seats:
+            # Re-encode of an unmaterialized arena view for the same
+            # committee: the wire section is reproduced from the slices.
+            seat_list, sig_buf, _ = raw
+            enc.u32(_V2_FLAG | len(seat_list))
+            enc.raw(_seats_bitmap(seat_list, seats.nbytes))
+            enc.raw(sig_buf)
+            return True
+        votes = self.votes
+        if not votes:
+            return False  # genesis stays v1 (no bitmap bytes for nothing)
+        index = seats.index
+        try:
+            pairs = sorted(
+                ((index[pk], sig) for pk, sig in votes), key=lambda p: p[0]
+            )
+        except KeyError:
+            return False  # a signer outside the table: fall back to v1
+        enc.u32(_V2_FLAG | len(pairs))
+        enc.raw(_seats_bitmap([s for s, _ in pairs], seats.nbytes))
+        for _, sig in pairs:
+            enc.raw(sig.data)
+        return True
 
     @classmethod
-    def decode(cls, dec: Decoder) -> "QC":
+    def decode(cls, dec: Decoder, seats: "SeatTable | None" = None) -> "QC":
         h = Digest(dec.raw(32))
         rnd = dec.u64()
-        votes = dec.seq(lambda d: (_intern_pk(d.raw(32)), Signature(d.raw(64))))
+        n = dec.u32()
+        if n & _V2_FLAG:
+            if seats is None:
+                raise SerdeError("v2 certificate without a seat table")
+            count = n & ~_V2_FLAG
+            if count > len(seats):
+                raise SerdeError(f"v2 vote count {count} exceeds committee")
+            seat_list = _bitmap_seats(dec.raw(seats.nbytes), len(seats))
+            if len(seat_list) != count:
+                raise SerdeError(
+                    f"v2 bitmap popcount {len(seat_list)} != count {count}"
+                )
+            sig_buf = dec.raw(64 * count)
+            qc = cls.__new__(cls)
+            qc.hash = h
+            qc.round = rnd
+            qc.__dict__["_raw_votes"] = (seat_list, sig_buf, seats)
+            return qc
+        if n > MAX_LEN:
+            raise SerdeError(f"sequence count {n} exceeds MAX_LEN")
+        votes = [
+            (_intern_pk(dec.raw(32)), Signature(dec.raw(64))) for _ in range(n)
+        ]
         return cls(h, rnd, votes)
 
     def __repr__(self) -> str:
@@ -201,8 +433,70 @@ class TC:
     round: Round
     votes: list[tuple[PublicKey, Signature, Round]]  # (author, sig, high_qc_round)
 
+    # Lazy votes, mirroring QC: a v2-decoded TC holds
+    # ``_raw_votes = (seat_indices, buf, seats)`` where ``buf`` packs
+    # ``count * (64B signature + u64 LE high_qc_round)`` in seat order.
+    _REC = 72  # bytes per packed v2 vote record
+
+    def __getattr__(self, name):
+        if name == "votes":
+            raw = self.__dict__.get("_raw_votes")
+            if raw is not None:
+                seat_list, buf, seats = raw
+                keys = seats.keys
+                rec = self._REC
+                votes = [
+                    (
+                        keys[s],
+                        Signature(buf[i * rec : i * rec + 64]),
+                        _U64.unpack_from(buf, i * rec + 64)[0],
+                    )
+                    for i, s in enumerate(seat_list)
+                ]
+                self.__dict__["votes"] = votes
+                return votes
+        raise AttributeError(name)
+
+    def n_votes(self) -> int:
+        votes = self.__dict__.get("votes")
+        if votes is not None:
+            return len(votes)
+        raw = self.__dict__.get("_raw_votes")
+        return len(raw[0]) if raw is not None else len(self.votes)
+
     def high_qc_rounds(self) -> list[Round]:
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+            if raw is not None:
+                _, buf, _ = raw
+                rec = self._REC
+                return [
+                    _U64.unpack_from(buf, i * rec + 64)[0]
+                    for i in range(len(raw[0]))
+                ]
         return [r for _, _, r in self.votes]
+
+    def _canonical_key(self) -> bytes:
+        raw = None
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+        if raw is not None:
+            seat_list, buf, seats = raw
+            keys = seats.keys
+            rec = self._REC
+            return b"".join(
+                (
+                    _U64.pack(self.round),
+                    _U32.pack(len(seat_list)),
+                    *(
+                        keys[s].data + buf[i * rec : i * rec + rec]
+                        for i, s in enumerate(seat_list)
+                    ),
+                )
+            )
+        enc = Encoder()
+        self.encode(enc)
+        return bytes(enc.finish())
 
     def verify(
         self, committee: Committee, cache: "CertificateCache | None" = None
@@ -217,47 +511,135 @@ class TC:
             key = CertificateCache.key_of(self)
             if cache.hit(key):
                 return
+        raw = None
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+        if raw is not None:
+            self._verify_raw(committee, raw)
+        else:
+            weight = 0
+            used = set()
+            for name, _, _ in self.votes:
+                if name in used:
+                    raise errors.AuthorityReuse(str(name))
+                stake = committee.stake(name)
+                if stake == 0:
+                    raise errors.UnknownAuthority(str(name))
+                used.add(name)
+                weight += stake
+            if weight < committee.quorum_threshold():
+                raise errors.TCRequiresQuorum("TC requires a quorum")
+            try:
+                Signature.verify_batch_multi(
+                    [
+                        (
+                            sha512_digest(
+                                _U64.pack(self.round), _U64.pack(hqc_round)
+                            ),
+                            author,
+                            sig,
+                        )
+                        for author, sig, hqc_round in self.votes
+                    ]
+                )
+            except BackendUnavailable:
+                raise  # infrastructure failure, NOT a byzantine signature
+            except CryptoError as e:
+                raise errors.InvalidSignature(str(e)) from e
+        if cache is not None:
+            cache.add(key)
+
+    def _verify_raw(self, committee: Committee, raw) -> None:
+        """Raw-slice verification of a lazily-decoded v2 TC (bitmap seats
+        are distinct by construction; acceptance identical to the
+        materialized path)."""
+        seat_list, buf, seats = raw
+        keys = seats.keys
+        rec = self._REC
         weight = 0
-        used = set()
-        for name, _, _ in self.votes:
-            if name in used:
-                raise errors.AuthorityReuse(str(name))
-            stake = committee.stake(name)
+        for s in seat_list:
+            stake = committee.stake(keys[s])
             if stake == 0:
-                raise errors.UnknownAuthority(str(name))
-            used.add(name)
+                raise errors.UnknownAuthority(str(keys[s]))
             weight += stake
         if weight < committee.quorum_threshold():
             raise errors.TCRequiresQuorum("TC requires a quorum")
+        round_le = _U64.pack(self.round)
         try:
-            Signature.verify_batch_multi(
+            get_backend().verify_batch(
                 [
-                    (
-                        sha512_digest(_U64.pack(self.round), _U64.pack(hqc_round)),
-                        author,
-                        sig,
-                    )
-                    for author, sig, hqc_round in self.votes
-                ]
+                    sha512_digest(round_le, buf[i * rec + 64 : i * rec + 72]).data
+                    for i in range(len(seat_list))
+                ],
+                [keys[s].data for s in seat_list],
+                [buf[i * rec : i * rec + 64] for i in range(len(seat_list))],
             )
         except BackendUnavailable:
             raise  # infrastructure failure, NOT a byzantine signature
         except CryptoError as e:
             raise errors.InvalidSignature(str(e)) from e
-        if cache is not None:
-            cache.add(key)
 
-    def encode(self, enc: Encoder) -> None:
-        enc.u64(self.round).seq(
+    def encode(self, enc: Encoder, seats: "SeatTable | None" = None) -> None:
+        enc.u64(self.round)
+        if seats is not None and self._encode_votes_v2(enc, seats):
+            return
+        enc.seq(
             self.votes, lambda e, v: e.raw(v[0].data).raw(v[1].data).u64(v[2])
         )
 
+    def _encode_votes_v2(self, enc: Encoder, seats: "SeatTable") -> bool:
+        raw = None
+        if "votes" not in self.__dict__:
+            raw = self.__dict__.get("_raw_votes")
+        if raw is not None and raw[2] is seats:
+            seat_list, buf, _ = raw
+            enc.u32(_V2_FLAG | len(seat_list))
+            enc.raw(_seats_bitmap(seat_list, seats.nbytes))
+            enc.raw(buf)
+            return True
+        votes = self.votes
+        if not votes:
+            return False
+        index = seats.index
+        try:
+            triples = sorted(
+                ((index[pk], sig, r) for pk, sig, r in votes),
+                key=lambda t: t[0],
+            )
+        except KeyError:
+            return False  # a signer outside the table: fall back to v1
+        enc.u32(_V2_FLAG | len(triples))
+        enc.raw(_seats_bitmap([s for s, _, _ in triples], seats.nbytes))
+        for _, sig, hqc_round in triples:
+            enc.raw(sig.data).u64(hqc_round)
+        return True
+
     @classmethod
-    def decode(cls, dec: Decoder) -> "TC":
+    def decode(cls, dec: Decoder, seats: "SeatTable | None" = None) -> "TC":
         rnd = dec.u64()
-        votes = dec.seq(
-            lambda d: (_intern_pk(d.raw(32)), Signature(d.raw(64)), d.u64())
-        )
+        n = dec.u32()
+        if n & _V2_FLAG:
+            if seats is None:
+                raise SerdeError("v2 certificate without a seat table")
+            count = n & ~_V2_FLAG
+            if count > len(seats):
+                raise SerdeError(f"v2 vote count {count} exceeds committee")
+            seat_list = _bitmap_seats(dec.raw(seats.nbytes), len(seats))
+            if len(seat_list) != count:
+                raise SerdeError(
+                    f"v2 bitmap popcount {len(seat_list)} != count {count}"
+                )
+            buf = dec.raw(cls._REC * count)
+            tc = cls.__new__(cls)
+            tc.round = rnd
+            tc.__dict__["_raw_votes"] = (seat_list, buf, seats)
+            return tc
+        if n > MAX_LEN:
+            raise SerdeError(f"sequence count {n} exceeds MAX_LEN")
+        votes = [
+            (_intern_pk(dec.raw(32)), Signature(dec.raw(64)), dec.u64())
+            for _ in range(n)
+        ]
         return cls(rnd, votes)
 
     def __repr__(self) -> str:
@@ -334,17 +716,17 @@ class Block:
         if self.tc is not None:
             self.tc.verify(committee, cache)
 
-    def encode(self, enc: Encoder) -> None:
-        self.qc.encode(enc)
-        enc.option(self.tc, lambda e, tc: tc.encode(e))
+    def encode(self, enc: Encoder, seats: "SeatTable | None" = None) -> None:
+        self.qc.encode(enc, seats)
+        enc.option(self.tc, lambda e, tc: tc.encode(e, seats))
         enc.raw(self.author.data).u64(self.round)
         enc.seq(self.payload, lambda e, d: e.raw(d.data))
         enc.raw(self.signature.data)
 
     @classmethod
-    def decode(cls, dec: Decoder) -> "Block":
-        qc = QC.decode(dec)
-        tc = dec.option(TC.decode)
+    def decode(cls, dec: Decoder, seats: "SeatTable | None" = None) -> "Block":
+        qc = QC.decode(dec, seats)
+        tc = dec.option(lambda d: TC.decode(d, seats))
         author = _intern_pk(dec.raw(32))
         rnd = dec.u64()
         payload = dec.seq(lambda d: Digest(d.raw(32)))
@@ -485,14 +867,17 @@ class Timeout:
             # one batch verification.
             self.high_qc.verify(committee, cache)
 
-    def encode(self, enc: Encoder) -> None:
-        self.high_qc.encode(enc)
+    def encode(self, enc: Encoder, seats: "SeatTable | None" = None) -> None:
+        self.high_qc.encode(enc, seats)
         enc.u64(self.round).raw(self.author.data).raw(self.signature.data)
 
     @classmethod
-    def decode(cls, dec: Decoder) -> "Timeout":
+    def decode(cls, dec: Decoder, seats: "SeatTable | None" = None) -> "Timeout":
         return cls(
-            QC.decode(dec), dec.u64(), PublicKey(dec.raw(32)), Signature(dec.raw(64))
+            QC.decode(dec, seats),
+            dec.u64(),
+            PublicKey(dec.raw(32)),
+            Signature(dec.raw(64)),
         )
 
     def __repr__(self) -> str:
@@ -510,10 +895,20 @@ TAG_TC = 3
 TAG_SYNC_REQUEST = 4
 
 
-def encode_propose(block: Block) -> bytes:
-    # Rides the block's memoized wire bytes (one encode per block per
-    # process, shared between broadcast and store).
-    return bytes([TAG_PROPOSE]) + block.serialize()
+def encode_propose(block: Block, seats: "SeatTable | None" = None) -> bytes:
+    # v1: rides the block's memoized wire bytes (one encode per block per
+    # process, shared between broadcast and store). With ``seats``, the
+    # wire carries the v2 (seat-bitmap) certificate encoding instead —
+    # memoized separately; the STORE format stays canonical v1.
+    if seats is None:
+        return bytes([TAG_PROPOSE]) + block.serialize()
+    memo = block.__dict__.get("_wire_v2")
+    if memo is None or memo[0] is not seats:
+        enc = Encoder()
+        block.encode(enc, seats)
+        memo = (seats, enc.finish())
+        block._wire_v2 = memo
+    return bytes([TAG_PROPOSE]) + memo[1]
 
 
 def encode_vote(vote: Vote) -> bytes:
@@ -522,15 +917,15 @@ def encode_vote(vote: Vote) -> bytes:
     return enc.finish()
 
 
-def encode_timeout(timeout: Timeout) -> bytes:
+def encode_timeout(timeout: Timeout, seats: "SeatTable | None" = None) -> bytes:
     enc = Encoder().u8(TAG_TIMEOUT)
-    timeout.encode(enc)
+    timeout.encode(enc, seats)
     return enc.finish()
 
 
-def encode_tc(tc: TC) -> bytes:
+def encode_tc(tc: TC, seats: "SeatTable | None" = None) -> bytes:
     enc = Encoder().u8(TAG_TC)
-    tc.encode(enc)
+    tc.encode(enc, seats)
     return enc.finish()
 
 
@@ -561,17 +956,27 @@ def decode_vote_frame(data: bytes) -> Vote:
     )
 
 
-def decode_message(data: bytes):
-    """Returns (kind, payload). Raises on malformed/byzantine input."""
+def decode_message(data: bytes, seats: "SeatTable | None" = None):
+    """Returns (kind, payload). Raises on malformed/byzantine input.
+
+    With ``seats``, wire-format v2 certificate sections (seat bitmap +
+    concatenated signatures) are accepted alongside v1; without it a v2
+    frame is rejected as malformed (a v1-only peer's behavior)."""
     dec = Decoder(data)
     tag = dec.u8()
     if tag == TAG_PROPOSE:
-        block = Block.decode(dec)
+        block = Block.decode(dec, seats)
         dec.finish()
-        # The canonical encoding means the frame's tail IS the block's
-        # serialization: attach it so store_block never re-encodes the
-        # 2f+1-vote QC it just decoded.
-        block._wire = bytes(data[1:])
+        # For a v1 frame the canonical encoding means the frame's tail IS
+        # the block's serialization: attach it so store_block never
+        # re-encodes the 2f+1-vote QC it just decoded. A v2 frame is NOT
+        # the store format (stores stay v1-canonical so restores never
+        # need a seat table) — serialize() re-encodes once per block,
+        # amortized process-wide by the decode arena.
+        if "_raw_votes" not in block.qc.__dict__ and (
+            block.tc is None or "_raw_votes" not in block.tc.__dict__
+        ):
+            block._wire = bytes(data[1:])
         return ("propose", block)
     elif tag == TAG_VOTE:
         out = ("vote", Vote(
@@ -579,9 +984,9 @@ def decode_message(data: bytes):
             Signature(dec.raw(64)),
         ))
     elif tag == TAG_TIMEOUT:
-        out = ("timeout", Timeout.decode(dec))
+        out = ("timeout", Timeout.decode(dec, seats))
     elif tag == TAG_TC:
-        out = ("tc", TC.decode(dec))
+        out = ("tc", TC.decode(dec, seats))
     elif tag == TAG_SYNC_REQUEST:
         out = ("sync_request", (Digest(dec.raw(32)), PublicKey(dec.raw(32))))
     else:

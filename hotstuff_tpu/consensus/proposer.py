@@ -44,6 +44,7 @@ class Proposer:
         rx_message: asyncio.Queue,
         tx_loopback: asyncio.Queue,
         benchmark: bool = False,
+        wire_seats=None,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -52,6 +53,8 @@ class Proposer:
         self.rx_message = rx_message
         self.tx_loopback = tx_loopback
         self.benchmark = benchmark
+        # Wire-format v2 seat table for outgoing proposals (None = v1).
+        self.wire_seats = wire_seats
         self.buffer: set[Digest] = set()
         self.network = ReliableSender()
 
@@ -102,7 +105,7 @@ class Proposer:
         # at each replica is wire + receiver decode + core queue wait).
         telemetry.trace_event(repr(self.name), round_, "propose_send")
 
-        serialized = encode_propose(block)
+        serialized = encode_propose(block, self.wire_seats)
         names_addresses = self.committee.broadcast_addresses(self.name)
         handlers = [
             (name, await self.network.send(addr, serialized))

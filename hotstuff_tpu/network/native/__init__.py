@@ -74,6 +74,18 @@ _EV_ACKED = 2
 _EV_GONE = 3
 _EV_VOTE_BATCH = 4
 
+# Command-ring record layouts (hs_net_cmds_flush). Little-endian, fixed
+# headers; see netcore.cpp for the authoritative spec.
+_RING_LID_U64 = struct.Struct("<BQQ")  # op 1 (set_round) / 2 (consumed)
+_RING_SEND_HDR = struct.Struct("<BHBI")  # op 3: port, host_len, payload_len
+_RING_BCAST_HDR = struct.Struct("<BHI")  # op 4: addrs_len, payload_len
+_RING_VF_HDR = struct.Struct("<BQI")  # op 5: listener_id, payload_len
+_RING_OP_SET_ROUND = 1
+_RING_OP_CONSUMED = 2
+_RING_OP_SEND = 3
+_RING_OP_BROADCAST = 4
+_RING_OP_VOTE_FILTER = 5
+
 # Fixed Vote wire frame length (consensus/messages.py layout) — the unit
 # EV_VOTE_BATCH payloads are sliced into.
 VOTE_WIRE_LEN = 137
@@ -167,6 +179,10 @@ def _load():
         lib.hs_net_stats_ex.argtypes = [
             ctypes.c_void_p, ctypes.POINTER(ctypes.c_uint64), ctypes.c_int
         ]
+        lib.hs_net_cmds_flush.restype = ctypes.c_int64
+        lib.hs_net_cmds_flush.argtypes = [
+            ctypes.c_void_p, ctypes.c_char_p, ctypes.c_uint32
+        ]
         # Make the hs_net_* boundary instrumentable: an active profiler
         # session wraps these entry points to count calls + wall ns (the
         # per-call ctypes/GIL toll); zero cost otherwise.
@@ -179,6 +195,7 @@ def _load():
                 "hs_net_send", "hs_net_broadcast", "hs_net_set_round",
                 "hs_net_consumed", "hs_net_reply", "hs_net_cancel",
                 "hs_net_drain", "hs_net_set_vote_filter",
+                "hs_net_cmds_flush",
             ],
         )
         _lib = lib
@@ -250,6 +267,23 @@ class NativeTransport:
         self._drop_warn_seen = {"filtered": 0, "send_drops": 0}
         self._drop_warn_at = 0.0
         self._drop_poll_at = time.monotonic() + _DROP_WARN_INTERVAL_S
+        # Command ring: loop-thread callers append fixed-layout records
+        # here instead of making one ctypes crossing (with its GIL
+        # release/reacquire) per command; ONE hs_net_cmds_flush per
+        # event-loop iteration ships the lot. At N=200 the per-round
+        # hs_net_set_round/hs_net_send crossings alone were 85% of the
+        # vote edge (results/profile-attribution-200.json) — the ring
+        # collapses ~N crossings per round into one. Off-loop callers
+        # (resolver worker, telemetry threads) keep the direct calls.
+        self._ring_enabled = os.environ.get("HOTSTUFF_CMD_RING", "1") != "0"
+        self._ring = bytearray()
+        self._ring_records = 0
+        self._ring_scheduled = False
+        self._ring_metrics_live = None
+        # Plain lifetime totals (tests/diagnostics; the telemetry mirror
+        # only records when the plane is enabled).
+        self.ring_flushes = 0
+        self.ring_total_records = 0
         # Telemetry: the engine's counters surface as gauges behind the
         # registry's one snapshot call (collector polls stats() lazily).
         from hotstuff_tpu import telemetry
@@ -264,6 +298,12 @@ class NativeTransport:
         inst._bind_loop()
         return inst
 
+    @classmethod
+    def get_if_live(cls) -> "NativeTransport | None":
+        """The process transport if one exists, WITHOUT binding it to a
+        loop — safe to call outside any event loop (tests/diagnostics)."""
+        return cls._instance
+
     def _bind_loop(self) -> None:
         loop = asyncio.get_running_loop()
         if self._loop is loop:
@@ -274,6 +314,10 @@ class NativeTransport:
                 prev.remove_reader(self._efd)
             except Exception:  # noqa: BLE001 — loop may be tearing down
                 pass
+        # Records parked behind a dead loop's never-run flush callback
+        # must not be lost (tests run many short loops): ship them now.
+        if self._ring_records:
+            self._flush_cmd_ring()
         # A previous loop is gone (tests): its futures can never be
         # awaited again. Cancel their ids in the C++ layer — otherwise the
         # orphaned inflight entries would FIFO-consume ACKs meant for new
@@ -291,6 +335,59 @@ class NativeTransport:
         mid = self._next_msg_id
         self._next_msg_id += 1
         return mid
+
+    # -- command ring --
+
+    def _ring_push(self, rec: bytes) -> bool:
+        """Append one record to the command ring and make sure a flush is
+        scheduled for the next event-loop iteration. Returns False when
+        the caller must fall back to its direct ctypes call: ring
+        disabled, no bound loop, or the calling thread is not the loop's
+        (the ring buffer is loop-thread-only by design — a lock here
+        would reintroduce the contention the ring removes)."""
+        loop = self._loop
+        if not self._ring_enabled or loop is None or loop.is_closed():
+            return False
+        try:
+            if asyncio.get_running_loop() is not loop:
+                return False
+        except RuntimeError:
+            return False
+        self._ring += rec
+        self._ring_records += 1
+        if not self._ring_scheduled:
+            self._ring_scheduled = True
+            # call_soon lands AFTER the currently-draining ready batch:
+            # every command appended during this loop iteration rides the
+            # same flush.
+            loop.call_soon(self._flush_cmd_ring)
+        return True
+
+    def _flush_cmd_ring(self) -> None:
+        self._ring_scheduled = False
+        n = self._ring_records
+        if not n:
+            return
+        buf = bytes(self._ring)
+        self._ring.clear()
+        self._ring_records = 0
+        self._lib.hs_net_cmds_flush(self._ctx, buf, len(buf))
+        self.ring_flushes += 1
+        self.ring_total_records += n
+        from hotstuff_tpu import telemetry
+
+        if self._ring_metrics_live != telemetry.enabled():
+            self._ring_metrics_live = telemetry.enabled()
+            self._g_ring_depth = telemetry.gauge("net.native.cmd_ring_depth")
+            self._m_ring_flushes = telemetry.counter(
+                "net.native.cmd_ring.flushes"
+            )
+            self._m_ring_records = telemetry.counter(
+                "net.native.cmd_ring.records"
+            )
+        self._g_ring_depth.set(n)
+        self._m_ring_flushes.inc()
+        self._m_ring_records.inc(n)
 
     def _resolve_fast(self, host: str) -> str | None:
         """Non-blocking resolution: IPv4 literals and cached names only.
@@ -401,6 +498,8 @@ class NativeTransport:
         return lid
 
     def consumed(self, lid: int, n: int) -> None:
+        if self._ring_push(_RING_LID_U64.pack(_RING_OP_CONSUMED, lid, n)):
+            return
         self._lib.hs_net_consumed(
             self._ctx, ctypes.c_uint64(lid), ctypes.c_uint64(n)
         )
@@ -418,11 +517,17 @@ class NativeTransport:
         """Push the committee table down to the C++ vote pre-stage."""
         packed = b"".join(authors)
         assert len(packed) == 32 * len(authors), "authors must be 32-byte keys"
+        if self._ring_push(
+            _RING_VF_HDR.pack(_RING_OP_VOTE_FILTER, lid, len(packed)) + packed
+        ):
+            return
         self._lib.hs_net_set_vote_filter(
             self._ctx, ctypes.c_uint64(lid), packed, len(authors)
         )
 
     def set_round(self, lid: int, round_: int) -> None:
+        if self._ring_push(_RING_LID_U64.pack(_RING_OP_SET_ROUND, lid, round_)):
+            return
         self._lib.hs_net_set_round(
             self._ctx, ctypes.c_uint64(lid), ctypes.c_uint64(round_)
         )
@@ -503,6 +608,18 @@ class NativeTransport:
             # reliable ACK futures stay pending until the caller cancels.
             self._park_send(host, port, data, reliable, msg_id)
             return
+        if not reliable and msg_id == 0:
+            # Best-effort sends ride the command ring; reliable sends
+            # stay direct (their ACK-future bookkeeping on the Python
+            # side is already per-message, and proposals are one frame
+            # per round — not the crossing storm the ring exists for).
+            rhost = resolved.encode()
+            if self._ring_push(
+                _RING_SEND_HDR.pack(_RING_OP_SEND, port, len(rhost), len(data))
+                + rhost
+                + data
+            ):
+                return
         self._lib.hs_net_send(
             self._ctx, resolved.encode(), ctypes.c_uint16(port),
             data, len(data), int(reliable), ctypes.c_uint64(msg_id),
@@ -523,6 +640,14 @@ class NativeTransport:
         if not tokens:
             return
         packed = " ".join(tokens).encode()
+        # Ring record caps the address list at u16 (fits ~2,900 resolved
+        # IPv4 peers); anything larger takes the direct call.
+        if len(packed) <= 0xFFFF and self._ring_push(
+            _RING_BCAST_HDR.pack(_RING_OP_BROADCAST, len(packed), len(data))
+            + packed
+            + data
+        ):
+            return
         self._lib.hs_net_broadcast(
             self._ctx, packed, len(packed), data, len(data)
         )

@@ -390,6 +390,21 @@ class NetCore {
     return true;
   }
 
+  // Bulk enqueue for the command ring (hs_net_cmds_flush): a whole
+  // event-loop iteration's commands take ONE mutex acquisition and ONE
+  // eventfd wake instead of one ctypes crossing + lock + wake each.
+  // Same cmd_mu_ contract and enq_ns stamping as push_cmd, so the
+  // cmd_service_* counters price ring-delivered commands identically.
+  bool push_cmds(std::deque<Command>&& cmds) {
+    uint64_t t = now_ns();
+    for (auto& c : cmds) c.enq_ns = t;
+    std::lock_guard<std::mutex> g(cmd_mu_);
+    if (!accepting_) return false;
+    for (auto& c : cmds) commands_.push_back(std::move(c));
+    wake();
+    return true;
+  }
+
   // Drain events into a packed buffer:
   //   [u8 type][u64 a][u64 b][u32 len][len bytes] ...
   // Returns bytes written (0 = nothing pending).
@@ -1508,6 +1523,92 @@ void hs_net_faults(void* ctx, const char* spec, uint32_t spec_len) {
   c.type = CMD_SET_FAULTS;
   c.payload.assign(spec, spec_len);
   static_cast<NetCore*>(ctx)->push_cmd(std::move(c));
+}
+
+// Command ring flush: ``buf`` holds ``len`` bytes of fixed-layout
+// little-endian records appended by the Python side over one event-loop
+// iteration, decoded here into ordinary commands and enqueued under ONE
+// cmd_mu_ acquisition + ONE eventfd wake. This is the batched form of
+// the per-call entry points above — at committee scale the Python loop
+// was paying ~N GIL re-acquisitions per round for hs_net_set_round /
+// hs_net_send / hs_net_consumed crossings alone (85% of the N=200 vote
+// edge, per the committed profile); the ring collapses them into one
+// crossing per loop iteration. Record layouts (all integers LE):
+//   op=1 SET_ROUND:       u8 op | u64 listener_id | u64 round
+//   op=2 CONSUMED:        u8 op | u64 listener_id | u64 n
+//   op=3 SEND_SIMPLE:     u8 op | u16 port | u8 host_len | u32 payload_len
+//                         | host | payload
+//   op=4 BROADCAST:       u8 op | u16 addrs_len | u32 payload_len
+//                         | addrs ("ip:port ip:port ...") | payload
+//   op=5 SET_VOTE_FILTER: u8 op | u64 listener_id | u32 payload_len
+//                         | n*32B author keys
+// A malformed record ends the parse (the Python side is the only
+// producer; truncation can only mean a caller bug, and enqueueing a
+// half-parsed tail would be worse than dropping it). Returns the number
+// of records enqueued, or -1 when the loop has shut down.
+int64_t hs_net_cmds_flush(void* ctx, const uint8_t* buf, uint32_t len) {
+  std::deque<Command> cmds;
+  uint32_t off = 0;
+  auto rd_u16 = [&](uint32_t at) {
+    uint16_t v;
+    memcpy(&v, buf + at, 2);
+    return v;
+  };
+  auto rd_u32 = [&](uint32_t at) {
+    uint32_t v;
+    memcpy(&v, buf + at, 4);
+    return v;
+  };
+  auto rd_u64 = [&](uint32_t at) {
+    uint64_t v;
+    memcpy(&v, buf + at, 8);
+    return v;
+  };
+  while (off < len) {
+    uint8_t op = buf[off];
+    Command c;
+    if ((op == 1 || op == 2) && off + 17 <= len) {
+      c.type = (op == 1) ? CMD_SET_ROUND : CMD_CONSUMED;
+      c.id = rd_u64(off + 1);
+      c.count = rd_u64(off + 9);
+      off += 17;
+    } else if (op == 3 && off + 8 <= len) {
+      uint16_t port = rd_u16(off + 1);
+      uint8_t hlen = buf[off + 3];
+      uint32_t plen = rd_u32(off + 4);
+      if (off + 8 + hlen + uint64_t(plen) > len) break;
+      c.type = CMD_SEND_SIMPLE;
+      c.host.assign(reinterpret_cast<const char*>(buf + off + 8), hlen);
+      c.port = port;
+      c.payload.assign(
+          reinterpret_cast<const char*>(buf + off + 8 + hlen), plen);
+      off += 8 + hlen + plen;
+    } else if (op == 4 && off + 7 <= len) {
+      uint16_t alen = rd_u16(off + 1);
+      uint32_t plen = rd_u32(off + 3);
+      if (off + 7 + alen + uint64_t(plen) > len) break;
+      c.type = CMD_BROADCAST;
+      c.host.assign(reinterpret_cast<const char*>(buf + off + 7), alen);
+      c.payload.assign(
+          reinterpret_cast<const char*>(buf + off + 7 + alen), plen);
+      off += 7 + alen + plen;
+    } else if (op == 5 && off + 13 <= len) {
+      uint32_t plen = rd_u32(off + 9);
+      if (off + 13 + uint64_t(plen) > len) break;
+      c.type = CMD_SET_VOTE_FILTER;
+      c.id = rd_u64(off + 1);
+      c.payload.assign(
+          reinterpret_cast<const char*>(buf + off + 13), plen);
+      off += 13 + plen;
+    } else {
+      break;  // unknown op or truncated record: stop
+    }
+    cmds.push_back(std::move(c));
+  }
+  int64_t n = int64_t(cmds.size());
+  if (n == 0) return 0;
+  if (!static_cast<NetCore*>(ctx)->push_cmds(std::move(cmds))) return -1;
+  return n;
 }
 
 void hs_net_close_listener(void* ctx, uint64_t listener_id) {

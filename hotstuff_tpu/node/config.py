@@ -172,6 +172,9 @@ class Parameters:
                     leader_elector=str(
                         c.get("leader_elector", "round-robin")
                     ),
+                    # Emit-side wire negotiation: decode always accepts
+                    # both formats, so this is safe to flip per epoch.
+                    wire_v2=bool(c.get("wire_v2", True)),
                 ),
                 MempoolParameters(
                     gc_depth=int(m.get("gc_depth", 50)),
@@ -195,6 +198,7 @@ class Parameters:
                     self.consensus.batch_vote_verification
                 ),
                 "leader_elector": self.consensus.leader_elector,
+                "wire_v2": self.consensus.wire_v2,
             },
             "mempool": {
                 "gc_depth": self.mempool.gc_depth,

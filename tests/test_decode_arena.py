@@ -1,0 +1,231 @@
+"""Shared decode arena: equivalence with the legacy per-engine decoder
+(every message type, both wire formats, randomized committees, malformed
+frames), sharing/identity behavior, and the LRU bounds.
+
+The arena memoizes a deterministic pure function, so its contract is
+exact equivalence: same results for well-formed frames, same exceptions
+for malformed ones — only the redundant re-parses disappear.
+"""
+
+import random
+import struct
+
+import pytest
+
+from hotstuff_tpu.consensus import Authority, Committee, decode_arena
+from hotstuff_tpu.consensus.decode_arena import DecodeArena, decode_shared
+from hotstuff_tpu.consensus.messages import (
+    QC,
+    TC,
+    Block,
+    SeatTable,
+    Timeout,
+    Vote,
+    decode_message,
+    encode_propose,
+    encode_sync_request,
+    encode_tc,
+    encode_timeout,
+    encode_vote,
+)
+from hotstuff_tpu.crypto import Signature, generate_keypair, sha512_digest
+from hotstuff_tpu.utils.serde import SerdeError
+
+_U64 = struct.Struct("<Q")
+
+
+def _committee(n, rng):
+    kps = [generate_keypair(seed=rng.randbytes(32)) for _ in range(n)]
+    committee = Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", 0)) for pk, _ in kps
+        }
+    )
+    return committee, kps
+
+
+def _frames(committee, kps, seats):
+    """One well-formed frame of every consensus message kind, in both
+    wire formats where the format matters."""
+    quorum = committee.quorum_threshold()
+    genesis = Block.genesis()
+    qc = QC(hash=genesis.digest(), round=1, votes=[])
+    qc.votes = [(pk, Signature.new(qc.digest(), sk)) for pk, sk in kps[:quorum]]
+    tc = TC(
+        round=2,
+        votes=[
+            (pk, Signature.new(sha512_digest(_U64.pack(2), _U64.pack(1)), sk), 1)
+            for pk, sk in kps[:quorum]
+        ],
+    )
+    pk0, sk0 = kps[0]
+    block = Block.new_from_key(
+        qc=qc, tc=tc, author=pk0, round_=2, payload=[], secret=sk0
+    )
+    vote = Vote.new_from_key(block.digest(), 2, pk0, sk0)
+    timeout = Timeout.new_from_key(qc, 3, pk0, sk0)
+    return [
+        encode_propose(block),
+        encode_propose(block, seats),
+        encode_vote(vote),
+        encode_timeout(timeout),
+        encode_timeout(timeout, seats),
+        encode_tc(tc),
+        encode_tc(tc, seats),
+        encode_sync_request(block.digest(), pk0),
+    ]
+
+
+def _semantically_equal(kind, a, b, committee):
+    if kind == "propose":
+        assert a.digest() == b.digest()
+        assert {(p.data, s.data) for p, s in a.qc.votes} == {
+            (p.data, s.data) for p, s in b.qc.votes
+        }
+        a.verify(committee)
+        b.verify(committee)
+    elif kind == "vote":
+        assert (a.hash, a.round, a.author, a.signature) == (
+            b.hash, b.round, b.author, b.signature,
+        )
+    elif kind == "timeout":
+        assert a.digest() == b.digest()
+        assert a.high_qc.n_votes() == b.high_qc.n_votes()
+        a.verify(committee)
+        b.verify(committee)
+    elif kind == "tc":
+        assert a.round == b.round
+        assert a.high_qc_rounds() == b.high_qc_rounds()
+        a.verify(committee)
+        b.verify(committee)
+    elif kind == "sync_request":
+        assert a == b
+    else:
+        raise AssertionError(f"unexpected kind {kind}")
+
+
+def test_arena_equivalence_property_over_randomized_committees():
+    """For every message type and both wire formats, an arena decode is
+    semantically identical to a fresh legacy decode — across several
+    randomized committees, repeated so hits are exercised too."""
+    rng = random.Random(41)
+    for n in (4, 7, 10):
+        committee, kps = _committee(n, rng)
+        seats = SeatTable.for_committee(committee)
+        arena = DecodeArena()
+        for frame in _frames(committee, kps, seats):
+            kind_fresh, payload_fresh = decode_message(frame, seats)
+            for _ in range(3):  # miss once, hit twice
+                kind_arena, payload_arena = arena.decode(frame, seats)
+                assert kind_arena == kind_fresh
+                _semantically_equal(
+                    kind_fresh, payload_fresh, payload_arena, committee
+                )
+        stats = arena.stats()
+        assert stats["hits"] > 0 and stats["bytes_saved"] > 0
+
+
+def test_arena_malformed_frame_rejection_parity():
+    """Malformed frames raise the same exception type on every arrival —
+    failures are never cached and never silently succeed."""
+    rng = random.Random(43)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    arena = DecodeArena()
+    good = encode_propose(
+        Block.new_from_key(
+            QC.genesis(), None, kps[0][0], 1, [], kps[0][1]
+        ),
+        seats,
+    )
+    cases = [
+        b"",  # empty
+        bytes([99]) + good[1:],  # unknown tag
+        good[:-3],  # truncated
+        good + b"\x00\x01",  # trailing garbage
+    ]
+    for frame in cases:
+        legacy_exc = None
+        try:
+            decode_message(frame, seats)
+        except Exception as e:  # noqa: BLE001 — capturing for parity
+            legacy_exc = type(e)
+        assert legacy_exc is not None
+        for _ in range(2):
+            with pytest.raises(legacy_exc):
+                arena.decode(frame, seats)
+    assert arena.stats()["entries"] == 0  # nothing malformed was cached
+
+
+def test_arena_shares_one_decoded_view():
+    rng = random.Random(47)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    arena = DecodeArena()
+    frame = _frames(committee, kps, seats)[1]  # v2 propose
+    _, first = arena.decode(frame, seats)
+    _, second = arena.decode(frame, seats)
+    assert first is second  # zero-copy reference, not a re-parse
+
+
+def test_arena_does_not_cache_votes_or_sync_requests():
+    rng = random.Random(53)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    arena = DecodeArena()
+    pk0, sk0 = kps[0]
+    vote_frame = encode_vote(Vote.new_from_key(Block.genesis().digest(), 1, pk0, sk0))
+    sync_frame = encode_sync_request(Block.genesis().digest(), pk0)
+    for frame in (vote_frame, sync_frame):
+        arena.decode(frame, seats)
+        arena.decode(frame, seats)
+    assert arena.stats()["entries"] == 0
+    assert arena.stats()["hits"] == 0
+
+
+def test_arena_keys_by_committee_fingerprint():
+    """The same bytes under two committees must not alias (v2 sections
+    mean different seat tables decode to different vote sets)."""
+    rng = random.Random(59)
+    committee_a, kps_a = _committee(4, rng)
+    committee_b, _ = _committee(4, rng)
+    seats_a = SeatTable.for_committee(committee_a)
+    seats_b = SeatTable.for_committee(committee_b)
+    frame = _frames(committee_a, kps_a, seats_a)[0]  # v1 propose
+    arena = DecodeArena()
+    _, view_a = arena.decode(frame, seats_a)
+    _, view_b = arena.decode(frame, seats_b)
+    assert view_a is not view_b
+    assert arena.stats()["entries"] == 2
+
+
+def test_arena_lru_bounds_entries_and_bytes():
+    rng = random.Random(61)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    arena = DecodeArena(max_entries=4, max_bytes=1 << 30)
+    pk0, sk0 = kps[0]
+    for r in range(1, 10):
+        block = Block.new_from_key(QC.genesis(), None, pk0, r, [], sk0)
+        arena.decode(encode_propose(block), seats)
+    stats = arena.stats()
+    assert stats["entries"] <= 4
+    assert stats["bytes"] <= 4 * 200
+
+    tiny = DecodeArena(max_entries=100, max_bytes=300)
+    for r in range(1, 6):
+        block = Block.new_from_key(QC.genesis(), None, pk0, r, [], sk0)
+        tiny.decode(encode_propose(block), seats)
+    assert tiny.stats()["bytes"] <= 300
+
+
+def test_decode_shared_module_entry_point():
+    rng = random.Random(67)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    frame = _frames(committee, kps, seats)[1]
+    k1, p1 = decode_shared(frame, seats)
+    k2, p2 = decode_shared(frame, seats)
+    assert k1 == k2 == "propose"
+    if decode_arena.enabled():
+        assert p1 is p2

@@ -215,3 +215,56 @@ async def test_native_fault_drop_pattern_replays_with_seed():
     assert first == second
     assert 0 < len(first) < 60  # p=0.5 drops some, passes some
     assert other != first  # different stream (overwhelmingly likely)
+
+
+def test_chaos_replay_on_native_plane_with_command_ring(monkeypatch):
+    """Satellite guard for the command ring: a seeded chaos scenario over
+    the NATIVE plane — with the batched hs_net_cmds_flush path active and
+    demonstrably exercised — must produce a byte-identical compiled fault
+    schedule across two runs (the ``Schedule.trace()`` replay contract)
+    and a clean safety/liveness verdict both times. Catches ring-flush
+    reordering bugs: a flush that reordered SET_ROUND/CONSUMED/SEND
+    records would stall the vote pre-stage or strand back-pressure and
+    surface here as a liveness failure."""
+    import hotstuff_tpu.consensus.consensus as consensus_mod
+    import hotstuff_tpu.consensus.core as core_mod
+
+    from hotstuff_tpu.faultline import Scenario, run_scenario
+
+    monkeypatch.setattr(consensus_mod, "Receiver", hsnative.NativeReceiver)
+    monkeypatch.setattr(core_mod, "SimpleSender", hsnative.NativeSimpleSender)
+
+    scenario = Scenario(
+        name="ring-replay", seed=8020, duration_s=5.0,
+        events=[
+            {"kind": "crash", "node": 1, "at": 0.5},
+            {"kind": "restart", "node": 1, "at": 2.0},
+            {"kind": "link", "src": 2, "dst": "*", "at": 1.0, "until": 4.0,
+             "drop": 0.05, "delay_ms": [1, 5]},
+        ],
+    )
+
+    transport = hsnative.NativeTransport.get_if_live()
+    traces, verdicts = [], []
+    for i in range(2):
+        flushes_before = transport.ring_flushes if transport else 0
+
+        async def run(base=BASE_PORT + 80 + 8 * i):
+            return await run_scenario(
+                scenario, 4, base_port=base, timeout_delay=500,
+                recovery_timeout_s=60.0,
+            )
+
+        result = asyncio.run(asyncio.wait_for(run(), timeout=120))
+        traces.append(result["trace"])
+        verdicts.append(result["verdict"])
+        transport = hsnative.NativeTransport.get_if_live()
+        assert transport is not None and transport._ring_enabled
+        assert transport.ring_flushes > flushes_before, (
+            "chaos run did not exercise the command ring"
+        )
+
+    assert traces[0] == traces[1], "replay trace diverged for equal seeds"
+    for verdict in verdicts:
+        assert verdict["safety"]["ok"], verdict["safety"]
+        assert verdict["liveness"]["recovered"], verdict["liveness"]

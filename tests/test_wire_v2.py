@@ -1,0 +1,305 @@
+"""Wire-format v2 (seat-bitmap certificates): round-trip, v1↔v2
+equivalence over randomized committees, malformed-frame rejection parity,
+lazy-vote semantics, and the intern-table LRU bound.
+
+v2 ships a QC as bitmap-of-seats + concatenated signatures (a TC adds a
+u64 high_qc_round per signature) instead of repeated 32-byte pubkeys —
+~33% smaller proposals at N=200. Decoders accept BOTH formats whenever a
+seat table is known; ``wire_v2`` only selects what a node emits, which is
+the whole interop story.
+"""
+
+import random
+import struct
+
+import pytest
+
+from hotstuff_tpu.consensus import Authority, Committee, errors
+from hotstuff_tpu.consensus.messages import (
+    QC,
+    TC,
+    Block,
+    CertificateCache,
+    SeatTable,
+    Timeout,
+    _PK_INTERN,
+    _PK_INTERN_CAP,
+    _intern_pk,
+    decode_message,
+    encode_propose,
+    encode_tc,
+    encode_timeout,
+)
+from hotstuff_tpu.crypto import Signature, generate_keypair, sha512_digest
+from hotstuff_tpu.utils.serde import Decoder, Encoder, SerdeError
+
+_U64 = struct.Struct("<Q")
+
+
+def _committee(n, rng):
+    kps = [generate_keypair(seed=rng.randbytes(32)) for _ in range(n)]
+    committee = Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", 0)) for pk, _ in kps
+        }
+    )
+    return committee, kps
+
+
+def _signed_block(kps, quorum, with_tc):
+    genesis = Block.genesis()
+    qc = QC(hash=genesis.digest(), round=1, votes=[])
+    qc.votes = [(pk, Signature.new(qc.digest(), sk)) for pk, sk in kps[:quorum]]
+    tc = None
+    if with_tc:
+        tc = TC(
+            round=2,
+            votes=[
+                (
+                    pk,
+                    Signature.new(
+                        sha512_digest(_U64.pack(2), _U64.pack(1)), sk
+                    ),
+                    1,
+                )
+                for pk, sk in kps[:quorum]
+            ],
+        )
+    pk, sk = kps[0]
+    return Block.new_from_key(
+        qc=qc, tc=tc, author=pk, round_=2, payload=[], secret=sk
+    )
+
+
+def _vote_set(qc):
+    return {(pk.data, sig.data) for pk, sig in qc.votes}
+
+
+def test_v2_roundtrip_byte_identical_and_semantically_equal():
+    """Property: over randomized committee sizes, a v2 frame decodes to a
+    certificate semantically identical to the v1 decode of the same
+    block, and re-encoding the decoded view reproduces the v2 bytes."""
+    rng = random.Random(7)
+    for n in (4, 7, 13, 33):
+        committee, kps = _committee(n, rng)
+        seats = SeatTable.for_committee(committee)
+        quorum = committee.quorum_threshold()
+        block = _signed_block(kps, quorum, with_tc=(n % 2 == 0))
+
+        w1 = encode_propose(block)
+        w2 = encode_propose(block, seats)
+        assert len(w2) < len(w1)  # the point of the exercise
+
+        k1, b1 = decode_message(w1, seats)
+        k2, b2 = decode_message(w2, seats)
+        assert k1 == k2 == "propose"
+        assert b1.digest() == b2.digest() == block.digest()
+        assert _vote_set(b1.qc) == _vote_set(b2.qc) == _vote_set(block.qc)
+        if block.tc is not None:
+            assert b2.tc.high_qc_rounds() == block.tc.high_qc_rounds()
+        b1.verify(committee)
+        b2.verify(committee)
+
+        # v2 re-encode of the (lazy) decoded view is byte-identical.
+        assert encode_propose(b2, seats) == w2
+
+
+def test_v2_timeout_and_tc_envelopes():
+    rng = random.Random(11)
+    committee, kps = _committee(7, rng)
+    seats = SeatTable.for_committee(committee)
+    quorum = 5
+    genesis = Block.genesis()
+    qc = QC(hash=genesis.digest(), round=1, votes=[])
+    qc.votes = [(pk, Signature.new(qc.digest(), sk)) for pk, sk in kps[:quorum]]
+    pk0, sk0 = kps[0]
+    t = Timeout.new_from_key(qc, 3, pk0, sk0)
+    wt = encode_timeout(t, seats)
+    kind, t2 = decode_message(wt, seats)
+    assert kind == "timeout"
+    t2.verify(committee)
+    assert t2.high_qc.n_votes() == quorum
+    assert encode_timeout(t2, seats) == wt
+
+    tc = TC(
+        round=2,
+        votes=[
+            (pk, Signature.new(sha512_digest(_U64.pack(2), _U64.pack(1)), sk), 1)
+            for pk, sk in kps[:quorum]
+        ],
+    )
+    wtc = encode_tc(tc, seats)
+    kind, tc2 = decode_message(wtc, seats)
+    assert kind == "tc"
+    tc2.verify(committee)
+    assert tc2.high_qc_rounds() == [1] * quorum
+    assert encode_tc(tc2, seats) == wtc
+
+
+def test_v1_peer_rejects_v2_and_v2_peer_accepts_v1():
+    """Interop contract: decoding WITHOUT a seat table (a v1-only peer)
+    rejects v2 frames as malformed; decoding WITH a table accepts both
+    formats — so emit-side negotiation can never split a committee of
+    v2-capable nodes."""
+    rng = random.Random(13)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    block = _signed_block(kps, 3, with_tc=False)
+    w1 = encode_propose(block)
+    w2 = encode_propose(block, seats)
+
+    with pytest.raises(SerdeError):
+        decode_message(w2)  # v1-only peer
+    decode_message(w1)  # v1-only peer, v1 frame: fine
+    _, b_from_v1 = decode_message(w1, seats)  # v2-capable peer, v1 frame
+    _, b_from_v2 = decode_message(w2, seats)
+    assert _vote_set(b_from_v1.qc) == _vote_set(b_from_v2.qc)
+
+
+def test_v2_malformed_frames_rejected():
+    """Byzantine-shaped v2 sections: popcount/count mismatch, bits beyond
+    the committee, counts beyond the committee, truncated signature
+    buffers — all must raise, never mis-decode."""
+    rng = random.Random(17)
+    committee, kps = _committee(7, rng)
+    seats = SeatTable.for_committee(committee)
+    block = _signed_block(kps, 5, with_tc=False)
+    w2 = bytearray(encode_propose(block, seats))
+    # Layout after tag: hash(32) round(8) count(4) bitmap(1) sigs...
+    count_off = 1 + 32 + 8
+    bitmap_off = count_off + 4
+
+    bad_count = bytearray(w2)
+    bad_count[count_off:count_off + 4] = struct.pack("<I", 0x80000000 | 6)
+    with pytest.raises(SerdeError):
+        decode_message(bytes(bad_count), seats)
+
+    bad_bit = bytearray(w2)
+    bad_bit[bitmap_off] = 0x80  # seat 7 of a 7-seat committee (bits 0-6)
+    with pytest.raises(SerdeError):
+        decode_message(bytes(bad_bit), seats)
+
+    huge_count = bytearray(w2)
+    huge_count[count_off:count_off + 4] = struct.pack("<I", 0x80000000 | 9999)
+    with pytest.raises(SerdeError):
+        decode_message(bytes(huge_count), seats)
+
+    truncated = bytes(w2[: bitmap_off + 1 + 64 * 3])  # 3 of 5 sigs
+    with pytest.raises(SerdeError):
+        decode_message(truncated, seats)
+
+
+def test_v2_lazy_votes_and_cache_key_parity():
+    """A v2-decoded QC exposes n_votes() and its certificate-cache key
+    without constructing a single Signature; the key equals the v1
+    canonical encoding, so v1 and v2 arrivals of the same certificate
+    share one cache entry."""
+    rng = random.Random(19)
+    committee, kps = _committee(7, rng)
+    seats = SeatTable.for_committee(committee)
+    block = _signed_block(kps, 5, with_tc=False)
+
+    _, b2 = decode_message(encode_propose(block, seats), seats)
+    qc = b2.qc
+    assert "_raw_votes" in qc.__dict__ and "votes" not in qc.__dict__
+    assert qc.n_votes() == 5
+    key_lazy = CertificateCache.key_of(qc)
+    assert "votes" not in qc.__dict__  # key derivation stayed lazy
+
+    # The same certificate decoded from a v1 frame keys identically.
+    _, b1 = decode_message(encode_propose(block), seats)
+    # v1 vote order is the sender's arrival order; canonicalize through
+    # a seat-ordered re-encode for the comparison.
+    enc = Encoder()
+    qc.encode(enc)  # materializes, v1 canonical (seat order)
+    assert key_lazy == enc.finish()
+
+    # Verification works straight off the raw slices and caches.
+    cache = CertificateCache()
+    qc.verify(committee, cache)
+    assert cache.hit(key_lazy)
+
+
+def test_v2_verify_rejects_bad_signature_and_foreign_committee():
+    rng = random.Random(23)
+    committee, kps = _committee(7, rng)
+    seats = SeatTable.for_committee(committee)
+    block = _signed_block(kps, 5, with_tc=False)
+    w2 = bytearray(encode_propose(block, seats))
+    # Corrupt one signature byte inside the v2 sig buffer.
+    sig_off = 1 + 32 + 8 + 4 + seats.nbytes + 10
+    w2[sig_off] ^= 0xFF
+    _, bad = decode_message(bytes(w2), seats)
+    with pytest.raises(errors.InvalidSignature):
+        bad.qc.verify(committee)
+
+    # Same frame judged against a DIFFERENT committee: unknown authority.
+    other_committee, _ = _committee(7, random.Random(99))
+    _, b2 = decode_message(encode_propose(block, seats), seats)
+    with pytest.raises(errors.UnknownAuthority):
+        b2.qc.verify(other_committee)
+
+
+def test_store_format_stays_v1_canonical():
+    """serialize() of a v2-decoded block re-encodes to v1 (seat order) so
+    restores and sync replies never need a seat table."""
+    rng = random.Random(29)
+    committee, kps = _committee(7, rng)
+    seats = SeatTable.for_committee(committee)
+    block = _signed_block(kps, 5, with_tc=False)
+    _, b2 = decode_message(encode_propose(block, seats), seats)
+    restored = Block.deserialize(b2.serialize())
+    assert restored.digest() == block.digest()
+    restored.verify(committee)
+
+
+def test_genesis_qc_stays_v1():
+    """An empty vote set never pays bitmap bytes (and genesis blocks stay
+    byte-identical across wire settings)."""
+    committee = Committee(
+        authorities={
+            pk: Authority(stake=1, address=("127.0.0.1", 0))
+            for pk, _ in [generate_keypair(seed=bytes([i]) * 32) for i in range(4)]
+        }
+    )
+    seats = SeatTable.for_committee(committee)
+    enc_v1, enc_v2 = Encoder(), Encoder()
+    QC.genesis().encode(enc_v1)
+    QC.genesis().encode(enc_v2, seats)
+    assert enc_v1.finish() == enc_v2.finish()
+
+
+def test_signer_outside_seat_table_falls_back_to_v1():
+    rng = random.Random(31)
+    committee, kps = _committee(4, rng)
+    seats = SeatTable.for_committee(committee)
+    stranger_pk, stranger_sk = generate_keypair(seed=b"\x55" * 32)
+    qc = QC(hash=Block.genesis().digest(), round=1, votes=[])
+    qc.votes = [(pk, Signature.new(qc.digest(), sk)) for pk, sk in kps[:3]]
+    qc.votes.append((stranger_pk, Signature.new(qc.digest(), stranger_sk)))
+    enc = Encoder()
+    qc.encode(enc, seats)
+    dec = Decoder(enc.finish())
+    decoded = QC.decode(dec, seats)  # must be a v1 section
+    dec.finish()
+    assert "_raw_votes" not in decoded.__dict__
+    assert _vote_set(decoded) == _vote_set(qc)
+
+
+def test_intern_pk_lru_bounds_and_keeps_hot_keys():
+    """The pubkey intern table is a bounded LRU: a byzantine key spray
+    evicts only the coldest entries — keys touched during the spray
+    (committee keys on every decode) survive, and evictions are counted."""
+    from hotstuff_tpu.consensus import messages as msgs
+
+    _PK_INTERN.clear()
+    before_evictions = msgs.intern_evictions
+    hot = _intern_pk(b"\x01" * 32)
+    for i in range(_PK_INTERN_CAP + 100):
+        _intern_pk(i.to_bytes(32, "big"))
+        if i % 97 == 0:
+            assert _intern_pk(b"\x01" * 32) is hot  # touched: stays hot
+    assert len(_PK_INTERN) <= _PK_INTERN_CAP
+    assert msgs.intern_evictions > before_evictions
+    assert _intern_pk(b"\x01" * 32) is hot  # survived the whole spray
+    _PK_INTERN.clear()
