@@ -380,6 +380,20 @@ def main() -> None:
         "stream after the run (default spec set, or a JSON spec file) and "
         "exit nonzero on violation",
     )
+    p.add_argument(
+        "--watch",
+        action="store_true",
+        help="with --telemetry: attach the live watchtower (tail-follows "
+        "the stream while the committee runs, scores every peer, prints "
+        "hotstuff-alert-v1 alerts as they fire, and — in-process — dumps "
+        "a flight record + bounded profile at the moment of detection)",
+    )
+    p.add_argument(
+        "--watch-capture",
+        metavar="DIR",
+        help="with --watch: directory for alert-triggered captures "
+        "(default: alongside the telemetry stream)",
+    )
     p.add_argument("--output", help="directory to append the result file to")
     args = p.parse_args()
 
@@ -425,6 +439,36 @@ def main() -> None:
     backend = get_backend().name
     f = (args.nodes - 1) // 3
     stage_profile = None
+    watch = None
+    if args.watch:
+        if not args.telemetry or args.mode != "protocol":
+            print(
+                "--watch requires --mode protocol with --telemetry PATH",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        from benchmark.watchtower import DirectoryWatch
+        from hotstuff_tpu import telemetry as _telemetry
+        from hotstuff_tpu.telemetry.watchtower import AlertCapture
+
+        stream_abs = os.path.abspath(args.telemetry)
+        capture = AlertCapture(
+            args.watch_capture
+            or os.path.join(os.path.dirname(stream_abs), "captures"),
+            # In-process: the watcher shares the engines' process, so an
+            # alert dumps the live trace ring + registry and runs a
+            # bounded profiler burst on the spot.
+            trace=_telemetry.trace_buffer(),
+            registry=_telemetry.get_registry(),
+        )
+        watch = DirectoryWatch(
+            os.path.dirname(stream_abs),
+            pattern=os.path.basename(stream_abs),
+            on_alert=capture,
+            alerts_path=stream_abs + ".alerts.jsonl",
+        )
+        capture.watchtower = watch.watch
+        watch.start()
     if args.mode == "protocol":
         try:
             per_round, stage_profile = asyncio.run(
@@ -438,6 +482,8 @@ def main() -> None:
         finally:
             if profiler is not None:
                 profiler.stop()
+            if watch is not None:
+                watch.stop()
     else:
         per_round = run_crypto_rounds(args.nodes, args.rounds, args.tc_heavy)
     # Ask the network package what it ACTUALLY selected (HOTSTUFF_NET=native
@@ -508,6 +554,24 @@ def main() -> None:
                         f"{100 * n / total:6.2f} {100 * cum_c[fn] / total:6.2f}"
                         f"  {fn}"
                     )
+
+    if watch is not None:
+        import json
+
+        alerts = watch.alerts()
+        board = watch.scoreboard()
+        print(
+            f"watchtower: {len(alerts)} alert(s), "
+            f"frontier={board['frontier']}, "
+            f"{board['rounds']} scored round(s), "
+            f"streams={json.dumps(watch.stats())}"
+        )
+        for alert in alerts:
+            print(
+                f"  ALERT {alert['detector']}: accused={alert['accused']} "
+                f"confidence={alert['confidence']} "
+                f"capture={alert.get('capture', {})}"
+            )
 
     if args.slo:
         if not args.telemetry:

@@ -35,9 +35,13 @@ from re import findall, search
 from statistics import mean
 
 from hotstuff_tpu.telemetry import (
+    ALERT_SCHEMA,
+    META_SCHEMA,
     PROFILE_SCHEMA,
     SCHEMA as SNAPSHOT_SCHEMA,
     TRACE_SCHEMA,
+    validate_alert_record,
+    validate_meta_record,
     validate_profile_record,
     validate_snapshot,
     validate_trace_record,
@@ -248,19 +252,24 @@ class StreamRecords:
 
     ``snapshots`` are the ``hotstuff-telemetry-v1`` lines, ``traces`` the
     interleaved ``hotstuff-trace-v1`` lines, ``profiles`` the
-    ``hotstuff-profile-v1`` sampling-profiler lines, ``skipped`` counts
-    lines that could not be used: a truncated FINAL line (a node crashed
-    or was SIGKILLed mid-write — expected under chaos, never fatal) and
+    ``hotstuff-profile-v1`` sampling-profiler lines, ``meta`` the
+    ``hotstuff-meta-v1`` stream self-descriptions (one per writer; a
+    restart of the same node appends another), ``alerts`` any
+    ``hotstuff-alert-v1`` watchtower records, ``skipped`` counts lines
+    that could not be used: a truncated FINAL line (a node crashed or
+    was SIGKILLed mid-write — expected under chaos, never fatal) and
     lines of unknown schema (forward compatibility). Malformed JSON
     anywhere but the last line still raises — mid-file corruption is a
     real bug, not crash fallout."""
 
-    __slots__ = ("snapshots", "traces", "profiles", "skipped")
+    __slots__ = ("snapshots", "traces", "profiles", "meta", "alerts", "skipped")
 
     def __init__(self) -> None:
         self.snapshots: list[dict] = []
         self.traces: list[dict] = []
         self.profiles: list[dict] = []
+        self.meta: list[dict] = []
+        self.alerts: list[dict] = []
         self.skipped = 0
 
 
@@ -295,9 +304,135 @@ def read_stream_records(path: str) -> StreamRecords:
             if problems:
                 raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
             records.profiles.append(obj)
+        elif schema == META_SCHEMA:
+            problems = validate_meta_record(obj)
+            if problems:
+                raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
+            records.meta.append(obj)
+        elif schema == ALERT_SCHEMA:
+            problems = validate_alert_record(obj)
+            if problems:
+                raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
+            records.alerts.append(obj)
         else:
             records.skipped += 1
     return records
+
+
+class StreamFollower:
+    """Tail-follow reader for one live telemetry stream: yields each
+    record (validated, any known schema) as the file grows — the
+    watchtower's ingestion primitive, and independently useful for any
+    ``--telemetry`` consumer that wants records before the run ends.
+
+    Live-stream realities it handles:
+
+    - the file may not exist yet (a node still booting): polls quietly;
+    - a **partial final line** (writer mid-append): buffered until its
+      newline arrives — a record is only parsed once complete;
+    - **rotation by truncation** (file size shrinks): reopens from the
+      start and counts ``truncations``;
+    - malformed or unknown-schema lines: counted in ``skipped`` and
+      skipped — a live follower cannot tell mid-file corruption from a
+      crash tail, and dying on it would kill monitoring exactly when
+      something is going wrong (the post-hoc ``read_stream_records``
+      stays strict).
+
+    Iterate it directly (blocking, ``poll_s`` between growth checks)
+    until ``stop()`` is called or ``stop_when`` returns True — both
+    finish with one final drain so nothing already on disk is lost —
+    or call :meth:`drain` for a non-blocking sweep of what's new.
+    """
+
+    def __init__(
+        self,
+        path: str,
+        *,
+        poll_s: float = 0.2,
+        stop_when=None,
+    ) -> None:
+        self.path = path
+        self.poll_s = poll_s
+        self.stop_when = stop_when
+        self.skipped = 0
+        self.truncations = 0
+        self.records_read = 0
+        self._offset = 0
+        self._buf = b""
+        self._stopped = False
+
+    def stop(self) -> None:
+        self._stopped = True
+
+    def _should_stop(self) -> bool:
+        return self._stopped or (
+            self.stop_when is not None and self.stop_when()
+        )
+
+    def drain(self) -> list[dict]:
+        """Non-blocking: parse and return every complete new record."""
+        try:
+            size = os.path.getsize(self.path)
+        except OSError:
+            return []  # not created yet (or vanished): keep polling
+        if size < self._offset:
+            # Rotation by truncation: the writer started the file over.
+            self._offset = 0
+            self._buf = b""
+            self.truncations += 1
+        if size == self._offset:
+            return []
+        with open(self.path, "rb") as f:
+            f.seek(self._offset)
+            chunk = f.read()
+            self._offset = f.tell()
+        self._buf += chunk
+        out: list[dict] = []
+        while True:
+            nl = self._buf.find(b"\n")
+            if nl < 0:
+                break  # partial final line: wait for the newline
+            line, self._buf = self._buf[:nl], self._buf[nl + 1:]
+            line = line.strip()
+            if not line:
+                continue
+            record = self._parse(line)
+            if record is not None:
+                self.records_read += 1
+                out.append(record)
+        return out
+
+    def _parse(self, line: bytes) -> dict | None:
+        try:
+            obj = json.loads(line)
+        except json.JSONDecodeError:
+            self.skipped += 1
+            return None
+        schema = obj.get("schema") if isinstance(obj, dict) else None
+        validator = {
+            SNAPSHOT_SCHEMA: validate_snapshot,
+            TRACE_SCHEMA: validate_trace_record,
+            PROFILE_SCHEMA: validate_profile_record,
+            META_SCHEMA: validate_meta_record,
+            ALERT_SCHEMA: validate_alert_record,
+        }.get(schema)
+        if validator is None or validator(obj):
+            self.skipped += 1
+            return None
+        return obj
+
+    def __iter__(self):
+        import time as _time
+
+        while not self._should_stop():
+            got = self.drain()
+            if got:
+                yield from got
+            else:
+                _time.sleep(self.poll_s)
+        # Final drain: records appended between the last poll and the
+        # stop signal (e.g. a final snapshot flushed at teardown).
+        yield from self.drain()
 
 
 class SnapshotStream(list):

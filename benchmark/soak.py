@@ -35,7 +35,9 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 from benchmark.local import LocalBench  # noqa: E402
 from benchmark.logs import ParseError, TelemetryParser, read_telemetry_stream  # noqa: E402
+from benchmark.watchtower import DirectoryWatch  # noqa: E402
 from hotstuff_tpu.telemetry import slo as slo_mod  # noqa: E402
+from hotstuff_tpu.telemetry.watchtower import AlertCapture, WatchtowerConfig  # noqa: E402
 
 SOAK_SCHEMA = "hotstuff-soak-verdict-v1"
 
@@ -69,6 +71,30 @@ def run_soak(args) -> dict:
         telemetry=True,
         chaos=chaos_path,
     )
+    logs_dir = os.path.join(work_dir, "logs")
+
+    # Live watchtower: tail every node's stream WHILE the soak runs, so
+    # an SLO breach mid-run carries a named suspect in the verdict and
+    # the capture evidence is written at the moment of detection, not at
+    # teardown. (bench.run() wipes work_dir first; the watch's rescan
+    # picks the fresh streams up as the nodes create them.)
+    watch = None
+    if not args.no_watch:
+        capture = AlertCapture(
+            os.path.join(work_dir, "captures"),
+            profile_s=0.0,  # nodes are other processes: evidence-only
+        )
+        watch = DirectoryWatch(
+            logs_dir,
+            config=WatchtowerConfig.from_dict(
+                json.load(open(args.watch_config))
+            ) if args.watch_config else None,
+            on_alert=capture,
+            alerts_path=os.path.join(logs_dir, "watchtower-alerts.jsonl"),
+        )
+        capture.watchtower = watch.watch
+        watch.start()
+
     parse_error = None
     summary = None
     try:
@@ -76,8 +102,9 @@ def run_soak(args) -> dict:
         summary = parser.result()
     except ParseError as e:
         parse_error = str(e)
-
-    logs_dir = os.path.join(work_dir, "logs")
+    finally:
+        if watch is not None:
+            watch.stop()
     streams: dict[str, list[dict]] = {}
     skipped = 0
     for fn in sorted(glob.glob(os.path.join(logs_dir, "telemetry-*.jsonl"))):
@@ -116,9 +143,13 @@ def run_soak(args) -> dict:
             and bench.chaos_verdict["liveness"]["recovered"]
         )
 
-    # Resource trajectory per node (first → last snapshot): the human-
-    # readable face of what the memory-growth SLOs judged.
+    # Resource + commit trajectory per node (first → last snapshot): the
+    # human-readable face of what the memory-growth SLOs judged, plus
+    # each node's commit height so a laggard that commits nothing in the
+    # tail is visible in the verdict itself — the chaos3 finding took
+    # diffing flight records to see; now it is one row here.
     resources: dict[str, dict] = {}
+    commit_heights: dict[str, dict] = {}
     for name, snaps in streams.items():
         if not snaps:
             continue
@@ -133,8 +164,33 @@ def run_soak(args) -> dict:
             b = last_snap.get("gauges", {}).get(gauge_name)
             if b is not None:
                 row[label] = {"first": a, "last": b}
+        h_first = first.get("gauges", {}).get("consensus.last_committed_round")
+        h_last = last_snap.get("gauges", {}).get(
+            "consensus.last_committed_round"
+        )
+        if h_last is not None:
+            heights = {"first": h_first, "last": h_last}
+            row["commit_height"] = heights
+            commit_heights[name] = dict(heights)
         if row:
             resources[name] = row
+    frontier = max(
+        (h["last"] for h in commit_heights.values()), default=None
+    )
+    commit_section = None
+    if commit_heights:
+        for h in commit_heights.values():
+            h["lag"] = frontier - h["last"]
+            h["advanced"] = (h["last"] - (h["first"] or 0)) > 0
+        commit_section = {
+            "frontier": frontier,
+            "nodes": commit_heights,
+            "laggards": sorted(
+                name
+                for name, h in commit_heights.items()
+                if not h["advanced"] or h["lag"] >= 8
+            ),
+        }
 
     # Function-level attribution from the nodes' profile records (only
     # present under --pyprof; absence is not an error).
@@ -175,6 +231,23 @@ def run_soak(args) -> dict:
     except ParseError:
         pass
 
+    # Watchtower verdict section: what the ONLINE plane concluded while
+    # the run was still going — every alert (with its accused peers and
+    # capture paths) plus the per-peer scoreboard, so an SLO breach has
+    # a named suspect without any post-hoc assembly.
+    alerts_section = None
+    if watch is not None:
+        alerts = watch.alerts()
+        alerts_section = {
+            "count": len(alerts),
+            "alerts": alerts,
+            "suspects": sorted(
+                {p for a in alerts for p in a["accused"]}
+            ),
+            "scoreboard": watch.scoreboard(),
+            "streams": watch.stats(),
+        }
+
     ok = slo_verdict["ok"] and chaos_ok and parse_error is None
     return {
         "schema": SOAK_SCHEMA,
@@ -191,6 +264,8 @@ def run_soak(args) -> dict:
         "chaos": bench.chaos_verdict,
         "telemetry": telemetry_summary,
         "resources": resources,
+        "commit": commit_section,
+        "alerts": alerts_section,
         "profile": profile_attr,
         "parse_error": parse_error,
         "skipped_stream_lines": skipped,
@@ -231,6 +306,13 @@ def main() -> None:
         "--pyprof", action="store_true",
         help="arm the sampling profiler in every node process and join "
         "the function-level attribution into the verdict",
+    )
+    p.add_argument(
+        "--no-watch", action="store_true",
+        help="disable the live watchtower (alerts section absent)",
+    )
+    p.add_argument(
+        "--watch-config", help="JSON WatchtowerConfig overrides",
     )
     p.add_argument(
         "--allow-violation-fraction", type=float, default=0.34,

@@ -92,7 +92,11 @@ def load_events(
                 bad_anchor = True
                 continue
             off = anchor["wall"] - anchor["mono"]
-            for seq, node, round_, stage, t in rec["events"]:
+            for ev in rec["events"]:
+                # Events are 5-tuples, or 6 with a detail payload (vote
+                # author/digest, commit height — the watchtower's fields);
+                # edge attribution only needs the first five.
+                seq, node, round_, stage, t = ev[:5]
                 events.append(
                     {
                         "seq": seq,

@@ -161,6 +161,14 @@ class Core:
         # trace stream (in-process committees share one ring buffer);
         # the 16-char base64 prefix is unique within any real committee.
         self._trace = telemetry.round_trace(node=repr(name))
+        # Peer-label cache for trace-event details (vote_rx/propose carry
+        # "<author>|<digest>" so stream analyzers can score per-peer
+        # behavior); repr(PublicKey) base64-encodes on every call, so the
+        # hot vote path interns the label once per peer instead. The
+        # one-entry digest memo exists because all 2f+1 votes of a round
+        # carry the SAME block hash — one encode per round, not per vote.
+        self._peer_labels: dict = {}
+        self._vote_digest_memo: tuple[bytes, str] | None = None
         # This node's verified-certificate memory: rebroadcast QCs/TCs
         # (every view-change timeout carries the same high_qc; every
         # TC-former broadcasts the TC; timers retransmit) verify once
@@ -258,7 +266,9 @@ class Core:
             self._m_blocks.inc()
             self._g_committed_round.set(blk.round)
             if self._trace is not None:
-                self._trace.mark_commit(blk.round)
+                self._trace.mark_commit(
+                    blk.round, f"h{self.last_committed_round}"
+                )
             if blk.payload:
                 log.info("Committed %s", blk)
                 for d in blk.payload:
@@ -293,6 +303,8 @@ class Core:
     async def local_timeout_round(self) -> None:
         log.warning("Timeout reached for round %d", self.round)
         self._m_timeouts.inc()
+        if self._trace is not None:
+            self._trace.mark_timeout(self.round)
         self.increase_last_voted_round(self.round)
         await self._persist_state()
         timeout = await Timeout.new(
@@ -311,6 +323,12 @@ class Core:
     # Votes beyond this many rounds ahead are dropped: bounds the state an
     # attacker can allocate for fabricated future rounds.
     MAX_ROUND_LOOKAHEAD = 1_000
+
+    def _peer_label(self, pk) -> str:
+        label = self._peer_labels.get(pk)
+        if label is None:
+            label = self._peer_labels[pk] = repr(pk)
+        return label
 
     def _effective_sigs(self, cert, n: int) -> int:
         """``n`` if the certificate must actually be verified, 0 when a
@@ -340,6 +358,17 @@ class Core:
             return
         if self._trace is not None:
             self._trace.mark_vote(vote.round)
+            # Per-peer accountability evidence: WHO voted, for WHAT — the
+            # watchtower's vote-participation and conflicting-vote
+            # (equivocation) scorers read these off the trace stream.
+            memo = self._vote_digest_memo
+            if memo is None or memo[0] != vote.hash.data:
+                memo = self._vote_digest_memo = (
+                    vote.hash.data, repr(vote.hash)
+                )
+            self._trace.mark_vote_rx(
+                vote.round, self._peer_label(vote.author) + "|" + memo[1]
+            )
         if vote.round > self.round + self.MAX_ROUND_LOOKAHEAD:
             log.warning("dropping vote %d rounds ahead", vote.round - self.round)
             return
@@ -696,7 +725,10 @@ class Core:
         digest = block.digest()
         self._m_proposals.inc()
         if self._trace is not None:
-            self._trace.mark_propose(block.round)
+            self._trace.mark_propose(
+                block.round,
+                self._peer_label(block.author) + "|" + repr(digest),
+            )
         # Redelivery short-circuit: helpers answer sync requests with
         # ancestor CHAINS, so bursts can re-include blocks already fully
         # processed (stored => verified, certificates applied, ancestry

@@ -103,7 +103,20 @@ class Proposer:
         # Cross-node trace anchor: the leader's broadcast instant is t=0
         # of the round's causal timeline (the propose_send→propose edge
         # at each replica is wire + receiver decode + core queue wait).
-        telemetry.trace_event(repr(self.name), round_, "propose_send")
+        # The detail names the author + block digest so stream analyzers
+        # can attribute the round's proposal and spot conflicting blocks
+        # (one extra digest hash per broadcast, leader-side only — and
+        # only when telemetry is enabled).
+        telemetry.trace_event(
+            repr(self.name),
+            round_,
+            "propose_send",
+            detail=(
+                f"{self.name!r}|{block.digest()!r}"
+                if telemetry.enabled()
+                else None
+            ),
+        )
 
         serialized = encode_propose(block, self.wire_seats)
         names_addresses = self.committee.broadcast_addresses(self.name)

@@ -86,6 +86,7 @@ class FaultPlane:
             set(addr_to_node) if consensus_addrs is None else set(consensus_addrs)
         )
         self._t0: float | None = None
+        self.started_wall: float | None = None
         # (time, is_heal, event) transitions in virtual-time order; heals
         # sort after activations at the same instant.
         self._transitions: list[tuple[float, int, object]] = []
@@ -117,6 +118,11 @@ class FaultPlane:
 
     def start(self, t0: float | None = None) -> "FaultPlane":
         self._t0 = time.monotonic() if t0 is None else t0
+        # Wall-clock anchor of virtual time 0: consumers that correlate
+        # schedule times with wall-stamped telemetry (the watchtower's
+        # detector bench measures time-to-detection against fault
+        # activation) read this instead of guessing.
+        self.started_wall = time.time()
         return self
 
     def vnow(self) -> float:

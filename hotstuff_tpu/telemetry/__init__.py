@@ -38,10 +38,13 @@ from collections import OrderedDict
 
 from .emitter import (
     DEFAULT_INTERVAL_S,
+    META_SCHEMA,
     SCHEMA,
     TelemetryEmitter,
     arm_shutdown_flush,
+    build_meta_record,
     build_snapshot,
+    validate_meta_record,
     validate_snapshot,
 )
 from .profiler import (
@@ -69,6 +72,13 @@ from .trace import (
     dump_flight_record,
     validate_trace_record,
 )
+from .watchtower import (
+    ALERT_SCHEMA,
+    AlertCapture,
+    Watchtower,
+    WatchtowerConfig,
+    validate_alert_record,
+)
 
 __all__ = [
     "COUNT_BUCKETS",
@@ -76,9 +86,17 @@ __all__ = [
     "FINE_DURATION_MS_BUCKETS",
     "SIZE_BYTES_BUCKETS",
     "SCHEMA",
+    "META_SCHEMA",
     "TRACE_SCHEMA",
     "FLIGHT_SCHEMA",
     "PROFILE_SCHEMA",
+    "ALERT_SCHEMA",
+    "AlertCapture",
+    "Watchtower",
+    "WatchtowerConfig",
+    "validate_alert_record",
+    "build_meta_record",
+    "validate_meta_record",
     "SamplingProfiler",
     "validate_profile_record",
     "DEFAULT_INTERVAL_S",
@@ -337,12 +355,14 @@ def trace_buffer() -> TraceBuffer:
     return _TRACE_BUFFER
 
 
-def trace_event(node: str, round_: int, stage: str) -> None:
+def trace_event(
+    node: str, round_: int, stage: str, detail: str | None = None
+) -> None:
     """Record one protocol trace event into the process ring (no-op when
     telemetry is disabled). For sites without a RoundTrace — the
     proposer's broadcast mark, faultline injections."""
     if _ENABLED:
-        _TRACE_BUFFER.record(node, round_, stage)
+        _TRACE_BUFFER.record(node, round_, stage, detail=detail)
 
 
 def reset_for_tests() -> None:

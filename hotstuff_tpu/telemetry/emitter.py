@@ -40,7 +40,61 @@ from .trace import build_trace_record, dump_flight_record
 log = logging.getLogger("telemetry")
 
 SCHEMA = "hotstuff-telemetry-v1"
+META_SCHEMA = "hotstuff-meta-v1"
 DEFAULT_INTERVAL_S = 5.0
+
+
+def build_meta_record(
+    node: str = "",
+    interval_s: float | None = None,
+    anchor: dict | None = None,
+) -> dict:
+    """The stream's self-description: every emitter writes one of these
+    as its FIRST record so a consumer (the watchtower, the validate CLI,
+    a human with ``head -1``) knows what it is looking at without
+    guessing from content — which schemas may appear, which node wrote
+    it, the wall-clock anchor that places the stream's monotonic trace
+    timestamps on a shared timeline, and the writer pid (restarts of the
+    same node produce a new meta record mid-stream: a visible epoch
+    boundary, not a silent counter reset)."""
+    from .profiler import PROFILE_SCHEMA
+    from .trace import TRACE_SCHEMA
+    from .watchtower import ALERT_SCHEMA
+
+    return {
+        "schema": META_SCHEMA,
+        "schemas": [SCHEMA, TRACE_SCHEMA, PROFILE_SCHEMA, ALERT_SCHEMA],
+        "node": node,
+        "pid": os.getpid(),
+        "ts": time.time(),
+        "anchor": anchor
+        or {"mono": time.perf_counter(), "wall": time.time()},
+        "interval_s": interval_s,
+    }
+
+
+def validate_meta_record(obj) -> list[str]:
+    """Schema check mirroring ``validate_snapshot``; returns problems."""
+    problems: list[str] = []
+    if not isinstance(obj, dict):
+        return [f"meta record is {type(obj).__name__}, not an object"]
+    if obj.get("schema") != META_SCHEMA:
+        problems.append(
+            f"schema is {obj.get('schema')!r}, want {META_SCHEMA!r}"
+        )
+    if not isinstance(obj.get("schemas"), list) or not all(
+        isinstance(s, str) for s in obj.get("schemas") or []
+    ):
+        problems.append("schemas missing or not a list of strings")
+    for key, types in (("node", str), ("pid", int), ("ts", (int, float))):
+        if not isinstance(obj.get(key), types):
+            problems.append(f"field {key!r} missing or mistyped")
+    anchor = obj.get("anchor")
+    if not isinstance(anchor, dict) or not all(
+        isinstance(anchor.get(k), (int, float)) for k in ("mono", "wall")
+    ):
+        problems.append("anchor missing mono/wall")
+    return problems
 
 
 def build_snapshot(registry, node: str = "", seq: int = 0, final: bool = False) -> dict:
@@ -134,6 +188,7 @@ class TelemetryEmitter:
         self._trace_seq = 0  # last trace event seq already streamed
         self._seq = 0
         self._final_done = False
+        self._meta_done = False
         self._task: asyncio.Task | None = None
         parent = os.path.dirname(os.path.abspath(path))
         os.makedirs(parent, exist_ok=True)
@@ -150,7 +205,25 @@ class TelemetryEmitter:
             self.registry, node=self.node, seq=self._seq, final=final
         )
         self._seq += 1
-        lines = [json.dumps(snapshot, separators=(",", ":"))]
+        lines = []
+        if not self._meta_done:
+            # Stream self-description rides as the first record this
+            # emitter contributes (per WRITER, not per file: in-process
+            # testbeds append several emitters to one file, and a node
+            # restart appends a fresh meta record — the epoch boundary).
+            self._meta_done = True
+            anchor = self.trace.anchor() if self.trace is not None else None
+            lines.append(
+                json.dumps(
+                    build_meta_record(
+                        node=self.node,
+                        interval_s=self.interval_s,
+                        anchor=anchor,
+                    ),
+                    separators=(",", ":"),
+                )
+            )
+        lines.append(json.dumps(snapshot, separators=(",", ":")))
         if self.trace is not None:
             events = self.trace.events_since(self._trace_seq)
             if events:

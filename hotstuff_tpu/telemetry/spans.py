@@ -24,10 +24,13 @@ Stage semantics (all durations in milliseconds, monotonic clock):
 
 Cross-node causality: when constructed with an ``events`` sink (a
 :class:`~.trace.TraceBuffer`) and a ``node`` label, every mark — plus the
-per-node-only ``verified``/``vote_send`` marks that have no local span —
-is ALSO recorded as a trace event, so ``benchmark/trace_assemble.py``
-can merge all nodes' streams into one causal timeline per block and
-attribute milliseconds to each cross-node edge.
+per-node-only ``verified``/``vote_send``/``vote_rx``/``timeout`` marks
+that have no local span — is ALSO recorded as a trace event, so
+``benchmark/trace_assemble.py`` can merge all nodes' streams into one
+causal timeline per block and attribute milliseconds to each cross-node
+edge, and ``hotstuff_tpu/telemetry/watchtower.py`` can score per-peer
+behavior (vote participation, commit-height lag, timeout emission,
+conflicting-vote evidence) from the same stream while it is written.
 """
 
 from __future__ import annotations
@@ -85,9 +88,11 @@ class RoundTrace:
         self._c_faulted = registry.counter("consensus.span.faulted_rounds")
         self._c_evicted = registry.counter("consensus.span.evicted_rounds")
 
-    def _emit(self, round_: int, stage: str, t: float) -> None:
+    def _emit(
+        self, round_: int, stage: str, t: float, detail: str | None = None
+    ) -> None:
         if self._events is not None:
-            self._events.record(self.node, round_, stage, t)
+            self._events.record(self.node, round_, stage, t, detail)
 
     def _marks(self, round_: int) -> list[float | None]:
         marks = self._rounds.get(round_)
@@ -106,13 +111,13 @@ class RoundTrace:
     # benchmark/profile_assemble.py uses against the trace edges). One
     # module-attribute read per mark when no profiler session is live.
 
-    def mark_propose(self, round_: int) -> None:
+    def mark_propose(self, round_: int, detail: str | None = None) -> None:
         if pyprof.TAGGING:
             pyprof.set_thread_stage("verify")
         marks = self._marks(round_)
         if marks[_PROPOSE] is None:
             marks[_PROPOSE] = t = time.perf_counter()
-            self._emit(round_, "propose", t)
+            self._emit(round_, "propose", t, detail)
 
     def mark_verified(self, round_: int) -> None:
         """The proposal's certificates passed verification on this node
@@ -136,6 +141,18 @@ class RoundTrace:
             marks[_VOTE] = t = time.perf_counter()
             self._emit(round_, "first_vote", t)
 
+    def mark_vote_rx(self, round_: int, detail: str) -> None:
+        """One admitted vote arrived at this collector (event-only).
+        ``detail`` is ``"<author>|<block digest>"`` — the per-peer
+        accountability evidence (vote participation, conflicting-vote
+        detection) the watchtower scores from."""
+        self._emit(round_, "vote_rx", time.perf_counter(), detail)
+
+    def mark_timeout(self, round_: int) -> None:
+        """This node fired a local timeout for ``round_`` (event-only:
+        the watchtower's timeout-emission-rate and grind evidence)."""
+        self._emit(round_, "timeout", time.perf_counter())
+
     def mark_qc(self, round_: int) -> None:
         if pyprof.TAGGING:
             pyprof.set_thread_stage("qc_to_commit")
@@ -148,14 +165,16 @@ class RoundTrace:
             if marks[_PROPOSE] is not None and marks[_VOTE] is not None:
                 self._h_pv.observe((marks[_VOTE] - marks[_PROPOSE]) * 1e3)
 
-    def mark_commit(self, round_: int) -> None:
+    def mark_commit(self, round_: int, detail: str | None = None) -> None:
         """Close round ``round_`` (and GC every older round: commits are
-        monotone, so anything below the committed round is finished)."""
+        monotone, so anything below the committed round is finished).
+        ``detail`` carries the node's commit height as ``"h<round>"`` so
+        stream analyzers read the frontier off the event itself."""
         if pyprof.TAGGING:
             pyprof.set_thread_stage("idle")
         now = time.perf_counter()
         marks = self._rounds.get(round_)
-        self._emit(round_, "commit", now)
+        self._emit(round_, "commit", now, detail)
         if marks is not None:
             if marks[_QC] is not None:
                 self._h_qc.observe((now - marks[_QC]) * 1e3)

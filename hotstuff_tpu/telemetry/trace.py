@@ -50,7 +50,8 @@ def _env_capacity() -> int:
 
 
 class TraceBuffer:
-    """Bounded ring of ``(seq, node, round, stage, t_mono)`` events.
+    """Bounded ring of ``(seq, node, round, stage, t_mono[, detail])``
+    events.
 
     ``seq`` is a process-wide monotonically increasing id: the emitter
     remembers the last seq it streamed and fetches only newer events
@@ -58,6 +59,13 @@ class TraceBuffer:
     ring (:meth:`snapshot_events`) — the two consumers never contend over
     a destructive drain. Eviction (ring overflow) is counted, never
     silent.
+
+    ``detail`` is an optional short string payload carrying the
+    per-event fields the streaming analyzers need beyond (node, round,
+    stage): a ``vote_rx`` event's ``"<author>|<block digest>"``, a
+    ``propose`` event's ``"<author>|<digest>"``, a ``commit`` event's
+    ``"h<last_committed_round>"``. Events without a detail stay
+    5-tuples, so pre-existing streams and consumers are unaffected.
     """
 
     __slots__ = (
@@ -75,7 +83,12 @@ class TraceBuffer:
         self.anchor_wall = time.time()
 
     def record(
-        self, node: str, round_: int, stage: str, t: float | None = None
+        self,
+        node: str,
+        round_: int,
+        stage: str,
+        t: float | None = None,
+        detail: str | None = None,
     ) -> None:
         if t is None:
             t = time.perf_counter()
@@ -83,7 +96,12 @@ class TraceBuffer:
             if len(self._events) == self.capacity:
                 self.evicted += 1
             self._seq += 1
-            self._events.append((self._seq, node, round_, stage, t))
+            if detail is None:
+                self._events.append((self._seq, node, round_, stage, t))
+            else:
+                self._events.append(
+                    (self._seq, node, round_, stage, t, detail)
+                )
 
     def last_seq(self) -> int:
         return self._seq
@@ -147,12 +165,13 @@ def validate_trace_record(obj) -> list[str]:
     for i, ev in enumerate(events):
         if (
             not isinstance(ev, (list, tuple))
-            or len(ev) != 5
+            or len(ev) not in (5, 6)
             or not isinstance(ev[0], int)
             or not isinstance(ev[1], str)
             or not isinstance(ev[2], int)
             or not isinstance(ev[3], str)
             or not isinstance(ev[4], (int, float))
+            or (len(ev) == 6 and not isinstance(ev[5], str))
         ):
             problems.append(f"event {i} malformed: {ev!r}")
             break

@@ -1,0 +1,208 @@
+"""StreamFollower (tail-follow) tests: incremental growth, partial final
+lines, rotation-by-truncation, stop semantics, and the stream
+self-description (meta record + validate CLI) it feeds on."""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+
+import pytest
+
+from benchmark.logs import StreamFollower, read_stream_records
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.telemetry import (
+    META_SCHEMA,
+    TelemetryEmitter,
+    build_meta_record,
+    validate_meta_record,
+)
+from hotstuff_tpu.telemetry.registry import Registry
+from hotstuff_tpu.telemetry.validate import validate_stream
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _snap_line(seq=0, node="n", counters=None):
+    from hotstuff_tpu.telemetry import build_snapshot
+
+    r = Registry()
+    for name, v in (counters or {}).items():
+        r.counter(name).inc(v)
+    snap = build_snapshot(r, node=node, seq=seq)
+    return json.dumps(snap)
+
+
+# -- incremental growth ------------------------------------------------------
+
+
+def test_follower_yields_records_as_file_grows(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    follower = StreamFollower(str(path), poll_s=0.01)
+    assert follower.drain() == []  # not created yet: no error, no data
+
+    path.write_text(_snap_line(0) + "\n")
+    got = follower.drain()
+    assert [g["seq"] for g in got] == [0]
+
+    with open(path, "a") as f:
+        f.write(_snap_line(1) + "\n" + _snap_line(2) + "\n")
+    got = follower.drain()
+    assert [g["seq"] for g in got] == [1, 2]
+    assert follower.drain() == []  # no growth, no records
+    assert follower.records_read == 3
+
+
+def test_follower_buffers_partial_final_line(tmp_path):
+    """A record is only parsed once its newline lands — the writer may
+    be mid-append at any poll."""
+    path = tmp_path / "telemetry-x.jsonl"
+    line = _snap_line(0)
+    cut = len(line) // 2
+    path.write_text(line[:cut])
+    follower = StreamFollower(str(path))
+    assert follower.drain() == []  # incomplete: buffered, not parsed
+    assert follower.skipped == 0
+    with open(path, "a") as f:
+        f.write(line[cut:] + "\n")
+    got = follower.drain()
+    assert len(got) == 1 and got[0]["seq"] == 0
+
+
+def test_follower_handles_rotation_by_truncation(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    path.write_text(_snap_line(0) + "\n" + _snap_line(1) + "\n")
+    follower = StreamFollower(str(path))
+    assert len(follower.drain()) == 2
+    # The writer starts the file over (log rotation by truncation).
+    path.write_text(_snap_line(0, node="fresh") + "\n")
+    got = follower.drain()
+    assert len(got) == 1 and got[0]["node"] == "fresh"
+    assert follower.truncations == 1
+
+
+def test_follower_skips_malformed_and_unknown_lines(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    path.write_text(
+        "not json\n"
+        + json.dumps({"schema": "hotstuff-future-v9"}) + "\n"
+        + _snap_line(0) + "\n"
+    )
+    follower = StreamFollower(str(path))
+    got = follower.drain()
+    assert len(got) == 1 and got[0]["seq"] == 0
+    assert follower.skipped == 2
+
+
+def test_follower_iter_stops_with_final_drain(tmp_path):
+    """stop() finishes the iteration AFTER one last drain, so records
+    appended between the last poll and the stop signal are not lost."""
+    path = tmp_path / "telemetry-x.jsonl"
+    path.write_text(_snap_line(0) + "\n")
+    follower = StreamFollower(str(path), poll_s=0.01)
+    got: list[dict] = []
+
+    def consume():
+        got.extend(follower)
+
+    t = threading.Thread(target=consume)
+    t.start()
+    deadline = time.time() + 5.0
+    while not got and time.time() < deadline:
+        time.sleep(0.01)
+    with open(path, "a") as f:
+        f.write(_snap_line(1) + "\n")
+    follower.stop()
+    t.join(5.0)
+    assert not t.is_alive()
+    assert [g["seq"] for g in got] == [0, 1]
+
+
+def test_follower_stop_when_predicate(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    path.write_text(_snap_line(0) + "\n")
+    follower = StreamFollower(
+        str(path), poll_s=0.01, stop_when=lambda: True
+    )
+    assert [g["seq"] for g in follower] == [0]
+
+
+# -- stream self-description -------------------------------------------------
+
+
+def test_emitter_writes_meta_record_first(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    r = Registry()
+    emitter = TelemetryEmitter(r, str(path), node="x", interval_s=1.0)
+    emitter.emit()
+    emitter.emit(final=True)
+    with open(path) as f:
+        first = json.loads(f.readline())
+    assert first["schema"] == META_SCHEMA
+    assert validate_meta_record(first) == []
+    assert first["node"] == "x"
+    assert "hotstuff-telemetry-v1" in first["schemas"]
+    records = read_stream_records(str(path))
+    assert len(records.meta) == 1
+    assert len(records.snapshots) == 2
+    assert records.skipped == 0  # meta is a known schema, not "skipped"
+
+
+def test_validate_meta_record_rejects_malformed():
+    good = build_meta_record(node="n", interval_s=1.0)
+    assert validate_meta_record(good) == []
+    assert validate_meta_record([]) != []
+    assert validate_meta_record(dict(good, schema="other")) != []
+    bad = dict(good)
+    bad.pop("anchor")
+    assert any("anchor" in p for p in validate_meta_record(bad))
+
+
+def test_validate_cli_counts_and_flags_problems(tmp_path):
+    path = tmp_path / "telemetry-x.jsonl"
+    r = Registry()
+    telemetry.enable()
+    emitter = TelemetryEmitter(
+        r, str(path), node="x", trace=telemetry.trace_buffer()
+    )
+    telemetry.trace_event("n0", 1, "propose")
+    emitter.emit(final=True)
+    report = validate_stream(str(path))
+    assert report["ok"] is True
+    assert report["counts"][META_SCHEMA] == 1
+    assert report["counts"]["hotstuff-telemetry-v1"] == 1
+    assert report["counts"]["hotstuff-trace-v1"] == 1
+    assert report["self_described"] is True
+
+    # A truncated tail is reported, not fatal; mid-file garbage is.
+    with open(path, "a") as f:
+        f.write('{"schema": "hotstuff-telemetry-v1", "trunca')
+    report = validate_stream(str(path))
+    assert report["ok"] is True and report["truncated_tail"] is True
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps({"schema": "hotstuff-telemetry-v1", "node": 3}) + "\n"
+    )
+    report = validate_stream(str(bad))
+    assert report["ok"] is False
+    assert report["problems"][0]["line"] == 1
+
+
+def test_validate_cli_main_exit_codes(tmp_path, capsys):
+    from hotstuff_tpu.telemetry.validate import main
+
+    good = tmp_path / "good.jsonl"
+    good.write_text(_snap_line(0) + "\n")
+    assert main([str(good)]) == 0
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("garbage\n" + _snap_line(0) + "\n")
+    assert main([str(bad), "--json"]) == 1
+    out = capsys.readouterr().out
+    assert "bad JSON" in out
