@@ -94,6 +94,8 @@ class Core:
         on_round_advance=None,
         profile: bool = False,
         wire_seats=None,
+        network=None,
+        timer=None,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -113,9 +115,13 @@ class Core:
         self.last_voted_round: Round = 0
         self.last_committed_round: Round = 0
         self.high_qc = QC.genesis()
-        self.timer = Timer(timeout_delay)
+        # IO seams: the asyncio stack uses the defaults (real timer, real
+        # best-effort sender); the deterministic simulation plane injects
+        # a virtual-clock timer and an effect-collecting outbox so the
+        # SAME handlers run sans-io (hotstuff_tpu/sim/machine.py).
+        self.timer = timer if timer is not None else Timer(timeout_delay)
         self.aggregator = Aggregator(committee)
-        self.network = SimpleSender()
+        self.network = network if network is not None else SimpleSender()
         # round -> set of known-byzantine vote keys (author||sig||hash);
         # GC'd with the aggregator on round advance.
         self._bad_sigs: dict[Round, set[bytes]] = {}
@@ -453,10 +459,17 @@ class Core:
         if attempt > self.QC_RETRY_MAX:
             log.error("giving up QC verification retries for %r", qc)
             return
+        self._call_later(self.QC_RETRY_BASE_S * attempt, ("qc_retry", (qc, attempt)))
+
+    def _call_later(self, delay_s: float, item) -> None:
+        """Re-inject ``item`` onto the merged event queue after
+        ``delay_s``. This is the Core's only self-scheduling primitive
+        (QC-retry backoff) — the simulation driver overrides it to push a
+        virtual-time event instead of sleeping."""
 
         async def later() -> None:
-            await asyncio.sleep(self.QC_RETRY_BASE_S * attempt)
-            await self.rx_message.put(("qc_retry", (qc, attempt)))
+            await asyncio.sleep(delay_s)
+            await self.rx_message.put(item)
 
         task = asyncio.create_task(later(), name="qc_retry")
         # Strong reference: a sleeping fire-and-forget task may otherwise
@@ -825,6 +838,39 @@ class Core:
 
     # -- main loop ----------------------------------------------------------
 
+    # Tagged-event dispatch table (kind -> handler method name): the
+    # sans-io seam. run() binds it for the asyncio loop below, and the
+    # simulation driver (hotstuff_tpu/sim/machine.py) binds the SAME
+    # table so both planes dispatch identical events to identical
+    # handlers — the real stack and the simulated one cannot drift.
+    HANDLERS = {
+        "propose": "handle_proposal",
+        "vote": "handle_vote",
+        "votes": "handle_vote_batch",  # native pre-stage batches
+        "timeout": "handle_timeout",
+        "tc": "handle_tc",
+        "qc_retry": "_handle_qc_retry",  # internal loopback
+        "loopback": "process_block",
+    }
+
+    # Sampling-profiler stage seeds: each dequeued event opens under the
+    # trace edge its handler starts in; the RoundTrace marks then refine
+    # the tag as the handler crosses edge boundaries (e.g. a "propose"
+    # event opens as ingress work — dedup lookups, leader checks — until
+    # mark_propose flips it to verify).
+    STAGE_SEEDS = {
+        "propose": "ingress",
+        "vote": "fanin",
+        "votes": "fanin",
+        "timeout": "view_change",
+        "tc": "view_change",
+        "qc_retry": "verify",
+        "loopback": "vote",
+    }
+
+    def bound_handlers(self) -> dict:
+        return {kind: getattr(self, name) for kind, name in self.HANDLERS.items()}
+
     async def _timer_pump(self) -> None:
         """Forward timer expiries into the merged event queue. Handshakes
         with the run loop (``_timer_handled``) so an expired-but-unhandled
@@ -853,30 +899,10 @@ class Core:
         # select-style three-task ``asyncio.wait`` — the old loop's task
         # churn (3 done-callback registrations + a create_task per event)
         # was a measurable slice of single-core round latency.
-        handlers = {
-            "propose": self.handle_proposal,
-            "vote": self.handle_vote,
-            "votes": self.handle_vote_batch,  # native pre-stage batches
-            "timeout": self.handle_timeout,
-            "tc": self.handle_tc,
-            "qc_retry": self._handle_qc_retry,  # internal loopback
-            "loopback": self.process_block,
-        }
-        # Sampling-profiler stage seeds: each dequeued event opens under
-        # the trace edge its handler starts in; the RoundTrace marks then
-        # refine the tag as the handler crosses edge boundaries (e.g. a
-        # "propose" event opens as ingress work — dedup lookups, leader
-        # checks — until mark_propose flips it to verify). One module
-        # attribute read per event when no profiler session is live.
-        stage_seeds = {
-            "propose": "ingress",
-            "vote": "fanin",
-            "votes": "fanin",
-            "timeout": "view_change",
-            "tc": "view_change",
-            "qc_retry": "verify",
-            "loopback": "vote",
-        }
+        handlers = self.bound_handlers()
+        # One module attribute read per event when no profiler session is
+        # live (see STAGE_SEEDS).
+        stage_seeds = self.STAGE_SEEDS
         self._timer_handled = asyncio.Event()
         timer_task = asyncio.create_task(self._timer_pump(), name="consensus_timer")
         if self._on_round_advance is not None:

@@ -26,7 +26,7 @@ from hotstuff_tpu.crypto import (
     PublicKey,
     SecretKey,
     Signature,
-    get_backend,
+    backend_verify_batch,
     sha512_digest,
 )
 from hotstuff_tpu.utils.serde import MAX_LEN, Decoder, Encoder, SerdeError
@@ -346,7 +346,7 @@ class QC:
             raise errors.QCRequiresQuorum("QC requires a quorum")
         digest = self.digest()
         try:
-            get_backend().verify_batch(
+            backend_verify_batch(
                 [digest.data] * len(seat_list),
                 [keys[s].data for s in seat_list],
                 [sig_buf[i * 64 : i * 64 + 64] for i in range(len(seat_list))],
@@ -566,7 +566,7 @@ class TC:
             raise errors.TCRequiresQuorum("TC requires a quorum")
         round_le = _U64.pack(self.round)
         try:
-            get_backend().verify_batch(
+            backend_verify_batch(
                 [
                     sha512_digest(round_le, buf[i * rec + 64 : i * rec + 72]).data
                     for i in range(len(seat_list))
@@ -689,12 +689,22 @@ class Block:
         return self.qc.hash
 
     def digest(self) -> Digest:
-        return sha512_digest(
-            self.author.data,
-            _U64.pack(self.round),
-            *[d.data for d in self.payload],
-            self.qc.hash.data,
-        )
+        # Memoized: a block's identity fields are immutable once decoded
+        # or constructed (the signature, which is set after, is NOT part
+        # of the digest), and the digest is recomputed on the commit
+        # walk, store keying, redelivery dedup, and trace details — a
+        # top-five hash bill at committee scale. Stored in the instance
+        # dict so dataclass __eq__/__repr__ (declared fields only) are
+        # untouched.
+        d = self.__dict__.get("_digest")
+        if d is None:
+            d = self.__dict__["_digest"] = sha512_digest(
+                self.author.data,
+                _U64.pack(self.round),
+                *[d.data for d in self.payload],
+                self.qc.hash.data,
+            )
+        return d
 
     def verify(
         self, committee: Committee, cache: "CertificateCache | None" = None

@@ -35,15 +35,24 @@ class Synchronizer:
         store: Store,
         tx_loopback: asyncio.Queue,
         sync_retry_delay: int,
+        clock=time.monotonic,
     ) -> None:
         self.name = name
         self.committee = committee
         self.store = store
         self.tx_loopback = tx_loopback
         self.sync_retry_delay = sync_retry_delay / 1000.0
+        # Injectable clock (default untouched): request timestamps must
+        # come from the same clock the simulation plane advances, or
+        # sim runs would judge expiry against wall time.
+        self._clock = clock
         self.network = SimpleSender()
         self._pending: set[Digest] = set()  # block digests being waited on
         self._requests: dict[Digest, float] = {}  # parent digest -> first-request ts
+        # parent digest -> last (re)send ts: a retried request re-arms at
+        # sync_retry_delay cadence instead of being re-broadcast on every
+        # poll tick once expired (the committee-wide duplicate storm).
+        self._last_sent: dict[Digest, float] = {}
         self._ancestor_cache: dict[bytes, Block] = {}  # digest -> Block
         self._tasks: set[asyncio.Task] = set()
         self._main = asyncio.create_task(self._run(), name="consensus_synchronizer")
@@ -52,6 +61,7 @@ class Synchronizer:
         await self.store.notify_read(wait_on.data)
         self._pending.discard(deliver.digest())
         self._requests.pop(deliver.parent(), None)
+        self._last_sent.pop(deliver.parent(), None)
         await self.tx_loopback.put(("loopback", deliver))
 
     def _suspend(self, block: Block) -> None:
@@ -74,7 +84,9 @@ class Synchronizer:
         if parent not in self._requests:
             log.debug("requesting sync for block %s", parent)
             telemetry.counter("consensus.sync_requests").inc()
-            self._requests[parent] = time.monotonic()
+            now = self._clock()
+            self._requests[parent] = now
+            self._last_sent[parent] = now
             address = self.committee.address(block.author)
             if address is not None:
                 self.network.send(
@@ -84,34 +96,61 @@ class Synchronizer:
     async def _run(self) -> None:
         while True:
             await asyncio.sleep(TIMER_ACCURACY)
-            now = time.monotonic()
+            # Idle fast path: with no outstanding requests (the steady
+            # state) the tick does no work at all — the old loop built
+            # the broadcast address list and sorted an empty view every
+            # TIMER_ACCURACY, forever, on every engine in the process.
+            if not self._requests:
+                continue
+            now = self._clock()
+            retries = self._expired_frontiers(now)
+            if not retries:
+                continue
             addresses = [
                 a for _, a in self.committee.broadcast_addresses(self.name)
             ]
-            # Retry only the walk FRONTIERS (the newest few expired
-            # requests = the deepest missing ancestors): their chain
-            # replies (helpers serve ancestors in bulk) plus the
-            # notify_read unwind heal everything shallower.
-            # Rebroadcasting every outstanding request — one per
-            # missed round — floods the committee with O(gap)
-            # redeliveries per tick, which is exactly the storm that
-            # kept a straggler from ever catching up. A small K (not
-            # 1) covers independent missing chains (e.g. a fork from
-            # a view change) so none starves behind another's walk.
-            expired = sorted(
-                (
-                    (ts, digest)
-                    for digest, ts in self._requests.items()
-                    if ts + self.sync_retry_delay < now
-                ),
-                key=lambda e: e[0],
-                reverse=True,
-            )
-            for _, frontier in expired[:3]:
+            for frontier in retries:
                 log.debug("requesting sync for block %s (retry)", frontier)
                 self.network.broadcast(
                     addresses, encode_sync_request(frontier, self.name)
                 )
+
+    #: how many expired frontiers to re-request per tick (see
+    #: _expired_frontiers).
+    RETRY_FRONTIERS = 3
+
+    def _expired_frontiers(self, now: float) -> list[Digest]:
+        """Expired requests worth re-broadcasting now, newest-first.
+
+        Retry only the walk FRONTIERS (the newest few expired requests =
+        the deepest missing ancestors): their chain replies (helpers
+        serve ancestors in bulk) plus the notify_read unwind heal
+        everything shallower. Rebroadcasting every outstanding request —
+        one per missed round — floods the committee with O(gap)
+        redeliveries per tick, which is exactly the storm that kept a
+        straggler from ever catching up. A small K (not 1) covers
+        independent missing chains (e.g. a fork from a view change) so
+        none starves behind another's walk.
+
+        Expiry judges the LAST send, not the first request: once a
+        request aged past sync_retry_delay the old loop re-broadcast it
+        on EVERY tick until it resolved — duplicate sync traffic the
+        helpers then answered with duplicate chains. Each retry now
+        re-arms the request for a full sync_retry_delay.
+        """
+        expired = sorted(
+            (
+                (self._requests[digest], digest)
+                for digest, sent in self._last_sent.items()
+                if sent + self.sync_retry_delay < now
+            ),
+            key=lambda e: e[0],
+            reverse=True,
+        )
+        retries = [digest for _, digest in expired[: self.RETRY_FRONTIERS]]
+        for digest in retries:
+            self._last_sent[digest] = now
+        return retries
 
     def is_pending(self, digest: Digest) -> bool:
         """True if ``digest`` is a block already suspended awaiting its

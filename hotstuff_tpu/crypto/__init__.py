@@ -138,6 +138,21 @@ class _GatedSigner:
         self.pub = _scalarmult_base(self.a)
 
     def sign(self, msg: bytes) -> bytes:
+        # RFC 8032 signing is deterministic in (key, msg): under the
+        # opt-in crypto memo (the simulation plane) repeated signings of
+        # byte-identical messages — a sweep's seeds share their
+        # fault-free prefixes — skip the scalar multiplication.
+        memo = _VERIFY_MEMO
+        if memo is not None:
+            key = (b"sign", self.prefix, msg)
+            sig = memo.get(key)
+            if sig is None:
+                sig = self._sign_now(msg)
+                _memo_put(memo, key, sig)
+            return sig
+        return self._sign_now(msg)
+
+    def _sign_now(self, msg: bytes) -> bytes:
         r = (
             int.from_bytes(
                 hashlib.sha512(self.prefix + msg).digest(), "little"
@@ -218,6 +233,14 @@ def _verify_single_gated(msg: bytes, pub: bytes, sig: bytes) -> bool:
             _STRICT_FUSER = False
     if _STRICT_FUSER is False:
         return ed25519_ref.verify(pub, msg, sig, strict=True)
+    if _VERIFY_MEMO is not None:
+        # Memo mode (the deterministic sim): the caller already dedups
+        # byte-identical verifies across time, so the fuser's concurrent
+        # dedup buys nothing and its cross-thread handoff (~0.2 ms per
+        # request) would dominate a simulated round. One direct MSM.
+        from .native_ed25519 import verify_single_strict_native
+
+        return verify_single_strict_native(msg, pub, sig)
     try:
         _STRICT_FUSER.verify_batch([msg], [pub], [sig])
         return True
@@ -225,6 +248,74 @@ def _verify_single_gated(msg: bytes, pub: bytes, sig: bytes) -> bool:
         raise
     except CryptoError:
         return False
+
+
+# -- opt-in process-wide verification-verdict memo ---------------------------
+#
+# Signature verification is a PURE function of (message, key, signature)
+# bytes, so memoizing verdicts is semantically invisible. It is still
+# opt-in: when one process models a whole committee, a memo hit skips
+# work every REAL node would have to perform itself, which would falsify
+# the perf benchmarks (the live planes only fuse CONCURRENT duplicates —
+# crypto/batching.py — which a real node's concurrent arrivals genuinely
+# share). The deterministic simulation plane (hotstuff_tpu/sim) enables
+# it: there the object of study is protocol behavior under fault
+# schedules, not per-node CPU, and byte-identical re-verifies across
+# simulated nodes and seeds are pure waste. Failure verdicts are cached
+# too (byzantine resends stay cheap); BackendUnavailable never is.
+
+_VERIFY_MEMO: dict | None = None
+_VERIFY_MEMO_CAP = 1 << 16
+
+
+def enable_verify_memo(enabled: bool = True) -> None:
+    """Turn the process-wide verification memo on (idempotent — an
+    existing memo is kept warm) or off (drops it)."""
+    global _VERIFY_MEMO
+    if enabled:
+        if _VERIFY_MEMO is None:
+            _VERIFY_MEMO = {}
+    else:
+        _VERIFY_MEMO = None
+
+
+def verify_memo_enabled() -> bool:
+    return _VERIFY_MEMO is not None
+
+
+def _memo_put(memo: dict, key, verdict) -> None:
+    if len(memo) >= _VERIFY_MEMO_CAP:
+        memo.clear()  # coarse bound; sim working sets rarely get here
+    memo[key] = verdict
+
+
+def backend_verify_batch(msgs, pubs, sigs) -> None:
+    """Dispatch a batch verification to the active backend through the
+    (opt-in) process-wide verdict memo. All structured certificate paths
+    (``Signature.verify_batch``/``verify_batch_multi`` and the wire-v2
+    raw-slice path in consensus/messages.py) route here."""
+    memo = _VERIFY_MEMO
+    if memo is None:
+        return get_backend().verify_batch(msgs, pubs, sigs)
+    # Canonical (order-independent) key: a QC's signature set is verified
+    # once by the assembling leader (aggregator arrival order) and again
+    # off the wire (seat-sorted v2 order) — same set, same verdict, one
+    # memo entry.
+    key = tuple(sorted(zip(msgs, pubs, sigs)))
+    hit = memo.get(key)
+    if hit is not None:
+        from hotstuff_tpu import telemetry
+
+        telemetry.counter("crypto.verify_memo.hits").inc()
+        if hit is True:
+            return
+        raise CryptoError(hit)
+    try:
+        get_backend().verify_batch(msgs, pubs, sigs)
+    except CryptoError as e:
+        _memo_put(memo, key, str(e))
+        raise
+    _memo_put(memo, key, True)
 
 
 class PublicKey:
@@ -355,6 +446,23 @@ class Signature:
     def verify(self, digest: Digest, public_key: PublicKey) -> None:
         """Strict single verification (reference ``verify`` → dalek
         ``verify_strict``, ``crypto/src/lib.rs:200-204``). Raises CryptoError."""
+        memo = _VERIFY_MEMO
+        if memo is None:
+            return self._verify_now(digest, public_key)
+        key = (digest.data, public_key.data, self.data)
+        hit = memo.get(key)
+        if hit is not None:
+            if hit is True:
+                return
+            raise CryptoError(hit)
+        try:
+            self._verify_now(digest, public_key)
+        except CryptoError as e:
+            _memo_put(memo, key, str(e))
+            raise
+        _memo_put(memo, key, True)
+
+    def _verify_now(self, digest: Digest, public_key: PublicKey) -> None:
         # OpenSSL's verify is cofactorless (sB == R + hA) and rejects
         # non-canonical s, matching verify_strict's equation; additionally
         # reject small-order R/A like dalek does.
@@ -382,7 +490,7 @@ class Signature:
         if any signature is invalid. Routed to the active backend.
         """
         votes = list(votes)
-        get_backend().verify_batch(
+        backend_verify_batch(
             [digest.data] * len(votes),
             [pk.data for pk, _ in votes],
             [sig.data for _, sig in votes],
@@ -396,7 +504,7 @@ class Signature:
         super-batching on device. ``items``: iterable of
         ``(Digest, PublicKey, Signature)``."""
         items = list(items)
-        get_backend().verify_batch(
+        backend_verify_batch(
             [d.data for d, _, _ in items],
             [pk.data for _, pk, _ in items],
             [sig.data for _, _, sig in items],

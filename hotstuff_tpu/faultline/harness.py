@@ -37,6 +37,7 @@ log = logging.getLogger("faultline")
 __all__ = ["run_scenario", "ScenarioRun"]
 
 _POLL_S = 0.05  # supervisor cadence; schedule times stay seed-derived
+_RECOVERY_POLL_S = 0.2  # recovery-tail probe cadence (wall, not scheduled)
 
 
 def _node_name(i: int) -> str:
@@ -76,12 +77,18 @@ class ScenarioRun:
         leader_elector: str = "",
         min_recovery_commits: int = 3,
         recovery_timeout_s: float = 30.0,
+        clock=time.monotonic,
     ) -> None:
         from hotstuff_tpu.consensus import Authority, Committee, Parameters
         from hotstuff_tpu.crypto import generate_keypair
 
         self.scenario = scenario
         self.n = n
+        # Injectable clock for the harness's OWN deadlines (boot, the
+        # recovery tail): defaults to wall time on the real planes; the
+        # simulation reuses the checker but supplies virtual deadlines,
+        # so no wall-clock value leaks into a simulated verdict.
+        self._clock = clock
         self.names = [_node_name(i) for i in range(n)]
         self.schedule = scenario.compile(self.names)
         self.min_recovery_commits = min_recovery_commits
@@ -266,9 +273,9 @@ class ScenarioRun:
         # commit. The deadline scales with committee size: N engines in
         # one process dial N*(N-1) connections before the first proposal
         # can quorum (minutes at N=100 on one core).
-        boot_deadline = time.monotonic() + max(120, 3 * self.n)
+        boot_deadline = self._clock() + max(120, 3 * self.n)
         while any(not self.commits[name] for name in self.names):
-            if time.monotonic() > boot_deadline:
+            if self._clock() > boot_deadline:
                 raise RuntimeError("committee failed to reach first commit")
             await asyncio.sleep(0.1)
         self.plane.start()
@@ -291,8 +298,8 @@ class ScenarioRun:
             for e in self.schedule.events
             if e.kind == "byzantine"
         }
-        deadline = time.monotonic() + self.recovery_timeout_s
-        while time.monotonic() < deadline:
+        deadline = self._clock() + self.recovery_timeout_s
+        while self._clock() < deadline:
             for action in self.plane.poll_actions():  # late heals
                 await self._enact(action)
             if all(
@@ -301,7 +308,7 @@ class ScenarioRun:
                 for n in expected
             ):
                 break
-            await asyncio.sleep(0.2)
+            await asyncio.sleep(_RECOVERY_POLL_S)
 
         verdict = check(
             self.schedule,

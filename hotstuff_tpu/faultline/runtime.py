@@ -79,8 +79,15 @@ class FaultPlane:
         schedule: Schedule,
         addr_to_node: dict[tuple[str, int], str],
         consensus_addrs: set[tuple[str, int]] | None = None,
+        clock=time.monotonic,
     ) -> None:
         self.schedule = schedule
+        # Injectable clock: virtual time is ``clock() - t0``. The real
+        # planes keep the default monotonic clock; the simulation plane
+        # passes its virtual clock so the SAME schedule machinery (and
+        # the same per-link RNG streams) enacts faults at simulated
+        # timestamps with zero real sleeping.
+        self._clock = clock
         self.addr_to_node = dict(addr_to_node)
         self.consensus_addrs = (
             set(addr_to_node) if consensus_addrs is None else set(consensus_addrs)
@@ -117,7 +124,7 @@ class FaultPlane:
     # -- clock / schedule ----------------------------------------------------
 
     def start(self, t0: float | None = None) -> "FaultPlane":
-        self._t0 = time.monotonic() if t0 is None else t0
+        self._t0 = self._clock() if t0 is None else t0
         # Wall-clock anchor of virtual time 0: consumers that correlate
         # schedule times with wall-stamped telemetry (the watchtower's
         # detector bench measures time-to-detection against fault
@@ -126,7 +133,7 @@ class FaultPlane:
         return self
 
     def vnow(self) -> float:
-        return 0.0 if self._t0 is None else time.monotonic() - self._t0
+        return 0.0 if self._t0 is None else self._clock() - self._t0
 
     def any_active(self) -> bool:
         """True while any fault is currently active (drives the
@@ -210,6 +217,13 @@ class FaultPlane:
             ev.until if heal else ev.at,
         )
 
+    def schedule_exhausted(self) -> bool:
+        """True once every scheduled transition (activations AND heals)
+        has been applied — i.e. virtual time has passed the whole
+        schedule. The sim plane gates its early-exit on this so a run
+        can never skip late faults by recovering quickly."""
+        return self._cursor >= len(self._transitions)
+
     def poll_actions(self) -> list[dict]:
         """Supervised actions due now (crash/restart/byzantine on-off),
         in schedule order. The runner enacts them against real engines or
@@ -235,7 +249,12 @@ class FaultPlane:
         return False
 
     def filter_send(
-        self, address: tuple[str, int], frame: bytes, payload_off: int = 0
+        self,
+        address: tuple[str, int],
+        frame: bytes,
+        payload_off: int = 0,
+        src: str | None = None,
+        dst: str | None = None,
     ):
         """Decide the fate of one outbound frame to ``address``.
 
@@ -245,12 +264,20 @@ class FaultPlane:
         ``frame`` begins its payload at ``payload_off`` (senders that
         pre-frame pass 4 to skip the length prefix); only the first
         payload byte is ever inspected (silent-leader suppression).
+
+        ``src``/``dst`` override endpoint resolution (default: the
+        contextvar sender identity and the address map). The simulation
+        plane passes both explicitly — it has no sender tasks to carry a
+        contextvar, and Twins runs route one address to several node
+        INSTANCES that partition independently.
         """
         self._advance()
-        src = hooks.current_node()
+        if src is None:
+            src = hooks.current_node()
         if src is None:
             return None  # external senders (clients) are never faulted
-        dst = self.addr_to_node.get(address)
+        if dst is None:
+            dst = self.addr_to_node.get(address)
         if dst is None:
             return None
         behaviors = self._behaviors.get(src)
@@ -304,15 +331,17 @@ class FaultPlane:
             self._m["duplicates"].inc(copies - 1)
         return ("deliver", delay, copies)
 
-    def filter_recv(self, address: tuple[str, int]):
+    def filter_recv(self, address: tuple[str, int], dst: str | None = None):
         """Receive-side filter for the listener bound to ``address``:
         applies ``side: "recv"`` link rules whose dst is this node
         (ingress loss where the sender cannot be instrumented). Returns
-        None (deliver) or ``("drop"|"deliver", delay_s)``."""
+        None (deliver) or ``("drop"|"deliver", delay_s)``. ``dst``
+        overrides address-map resolution (see ``filter_send``)."""
         self._advance()
         if not self._links:
             return None
-        dst = self.addr_to_node.get(address)
+        if dst is None:
+            dst = self.addr_to_node.get(address)
         if dst is None:
             return None
         for rule in self._links:

@@ -1,0 +1,32 @@
+"""Simulant: deterministic simulation of the consensus protocol.
+
+FoundationDB-style deterministic simulation testing for this codebase:
+the sans-io :class:`CoreStateMachine` (the REAL ``Core`` handlers behind
+effect-collecting IO seams) runs N-node committees on a single
+virtual-time event heap (:class:`SimWorld`), enacting the existing
+faultline scenario schema through the existing :class:`FaultPlane` and
+judging with the existing checker — thousands of seeded fault schedules
+per CI minute instead of wall-clock minutes per seed.
+
+Entry points:
+
+- :func:`run_sim` — one scenario, one verdict (harness-shaped result);
+- :mod:`~hotstuff_tpu.sim.twins` — Twins-style systematic equivocation
+  scenario generation (duplicate identity across partitions);
+- :mod:`~hotstuff_tpu.sim.shrink` — minimize a failing schedule to a
+  pinned reproducer;
+- ``benchmark/sim_sweep.py`` — the checker-gated seed-range sweep.
+"""
+
+from .clock import VirtualClock
+from .machine import CoreStateMachine, SimSuspended
+from .world import EventHeap, SimWorld, run_sim
+
+__all__ = [
+    "CoreStateMachine",
+    "EventHeap",
+    "SimSuspended",
+    "SimWorld",
+    "VirtualClock",
+    "run_sim",
+]

@@ -1,0 +1,109 @@
+"""Synchronizer retry/idle behavior: the injected clock, the idle-tick
+fast path, and re-request dedup (one re-broadcast per sync_retry_delay,
+not one per poll tick)."""
+
+import asyncio
+
+from hotstuff_tpu.consensus import synchronizer as sync_mod
+from hotstuff_tpu.consensus.synchronizer import Synchronizer
+
+from .common import async_test, chain, consensus_committee
+
+BASE = 27600
+
+
+def _bare(retry_delay_s: float) -> Synchronizer:
+    """State-only instance (no tasks) for unit-testing the retry policy."""
+    s = Synchronizer.__new__(Synchronizer)
+    s.sync_retry_delay = retry_delay_s
+    s._requests = {}
+    s._last_sent = {}
+    return s
+
+
+def test_expired_frontiers_rearm_instead_of_rebroadcasting_every_tick():
+    s = _bare(2.0)
+    s._requests["d1"] = 0.0
+    s._last_sent["d1"] = 0.0
+    assert s._expired_frontiers(1.0) == []  # not expired yet
+    assert s._expired_frontiers(2.5) == ["d1"]  # expired: retry once
+    # The retry re-armed the request: the next ticks inside the delay
+    # window do NOT re-broadcast (the old behavior re-sent every tick).
+    assert s._expired_frontiers(3.0) == []
+    assert s._expired_frontiers(4.0) == []
+    assert s._expired_frontiers(5.0) == ["d1"]  # a full delay later
+
+
+def test_expired_frontiers_newest_first_capped():
+    s = _bare(1.0)
+    for i in range(6):
+        s._requests[f"d{i}"] = float(i)  # d5 newest
+        s._last_sent[f"d{i}"] = float(i)
+    got = s._expired_frontiers(10.0)
+    assert got == ["d5", "d4", "d3"]  # frontier cap, newest first
+    # Only the retried three re-armed; the rest stay expired.
+    assert s._expired_frontiers(10.0) == ["d2", "d1", "d0"]
+
+
+@async_test(timeout=30)
+async def test_idle_loop_never_touches_the_network():
+    committee = consensus_committee(BASE)
+    from hotstuff_tpu.store import Store
+
+    name = committee.sorted_keys()[0]
+    s = Synchronizer(name, committee, Store(), asyncio.Queue(), 5_000)
+    sent = []
+    s.network = type(
+        "Rec", (), {
+            "send": lambda self, a, d: sent.append(("send", a)),
+            "broadcast": lambda self, addrs, d: sent.append(("bcast", tuple(addrs))),
+        },
+    )()
+    old = sync_mod.TIMER_ACCURACY
+    sync_mod.TIMER_ACCURACY = 0.02
+    try:
+        await asyncio.sleep(0.15)  # several idle ticks
+        assert sent == []
+        # Register a request with an expired last-send: exactly one
+        # retry broadcast per retry window.
+        blocks = chain(3)
+        s._requests[blocks[1].parent()] = 0.0
+        s._last_sent[blocks[1].parent()] = -10.0
+        await asyncio.sleep(0.15)
+        bcasts = [e for e in sent if e[0] == "bcast"]
+        assert len(bcasts) == 1, sent  # re-armed, not per-tick
+    finally:
+        sync_mod.TIMER_ACCURACY = old
+        s.shutdown()
+
+
+@async_test(timeout=30)
+async def test_suspend_timestamps_come_from_injected_clock():
+    committee = consensus_committee(BASE + 50)
+    from hotstuff_tpu.store import Store
+
+    blocks = chain(3)
+    fake_now = [1234.5]
+    s = Synchronizer(
+        committee.sorted_keys()[0], committee, Store(), asyncio.Queue(),
+        5_000, clock=lambda: fake_now[0],
+    )
+    sent = []
+    s.network = type(
+        "Rec", (), {
+            "send": lambda self, a, d: sent.append(a),
+            "broadcast": lambda self, addrs, d: None,
+        },
+    )()
+    try:
+        s._suspend(blocks[2])
+        parent = blocks[2].parent()
+        assert s._requests[parent] == 1234.5
+        assert s._last_sent[parent] == 1234.5
+        assert s.requested(parent)
+        assert len(sent) == 1  # the initial targeted request
+        # Re-suspending the same block is a no-op (no duplicate request).
+        s._suspend(blocks[2])
+        assert len(sent) == 1
+    finally:
+        s.shutdown()
