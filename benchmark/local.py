@@ -23,6 +23,7 @@ from hotstuff_tpu.consensus import Parameters as CParams
 from hotstuff_tpu.mempool import Authority as MAuth
 from hotstuff_tpu.mempool import Committee as MCommittee
 from hotstuff_tpu.mempool import Parameters as MParams
+from hotstuff_tpu.mempool import WorkerEntry
 from hotstuff_tpu.node.config import Committee, Parameters, Secret
 
 from .logs import LogParser
@@ -54,6 +55,7 @@ class LocalBench:
         crypto_backend: str = "cpu",
         telemetry: bool = False,
         chaos: str | None = None,
+        workers: int = 0,
     ) -> None:
         self.nodes = nodes
         self.rate = rate
@@ -75,6 +77,12 @@ class LocalBench:
         # ``self.chaos_verdict``.
         self.chaos = chaos
         self.chaos_verdict: dict | None = None
+        # Conveyor data plane: worker shards per node. Port layout
+        # extends the reference blocks — worker w of node i listens on
+        # base + (3 + 2w) * n + i (client ingress) and
+        # base + (4 + 2w) * n + i (peer port). Clients switch to the
+        # sharded bundle generator targeting their node's ingress ports.
+        self.workers = workers
         self._procs: list[subprocess.Popen] = []
         self._node_procs: dict[int, subprocess.Popen] = {}
         self._node_cmds: dict[int, tuple[list, str]] = {}  # i -> (cmd, log)
@@ -142,6 +150,19 @@ class LocalBench:
                     stake=1,
                     transactions_address=("127.0.0.1", self.base_port + n + i),
                     mempool_address=("127.0.0.1", self.base_port + 2 * n + i),
+                    workers=[
+                        WorkerEntry(
+                            transactions_address=(
+                                "127.0.0.1",
+                                self.base_port + (3 + 2 * w) * n + i,
+                            ),
+                            worker_address=(
+                                "127.0.0.1",
+                                self.base_port + (4 + 2 * w) * n + i,
+                            ),
+                        )
+                        for w in range(self.workers)
+                    ],
                 )
                 for i, s in enumerate(secrets)
             }
@@ -151,7 +172,11 @@ class LocalBench:
         params_file = os.path.join(self.work_dir, "parameters.json")
         Parameters(
             CParams(timeout_delay=self.timeout_delay),
-            MParams(batch_size=self.batch_size, max_batch_delay=self.max_batch_delay),
+            MParams(
+                batch_size=self.batch_size,
+                max_batch_delay=self.max_batch_delay,
+                workers=self.workers,
+            ),
         ).write(params_file)
 
         key_files = []
@@ -191,6 +216,13 @@ class LocalBench:
                 node_addrs = [
                     f"127.0.0.1:{self.base_port + n + j}" for j in range(booted)
                 ]
+                shard_args = []
+                if self.workers:
+                    shards = ",".join(
+                        f"127.0.0.1:{self.base_port + (3 + 2 * w) * n + i}"
+                        for w in range(self.workers)
+                    )
+                    shard_args = ["--shards", shards]
                 log_file = open(os.path.join(logs_dir, f"client-{i}.log"), "w")
                 self._procs.append(
                     subprocess.Popen(
@@ -205,6 +237,7 @@ class LocalBench:
                             str(self.rate // booted),
                             "--timeout",
                             str(self.timeout_delay),
+                            *shard_args,
                             "--nodes",
                             *node_addrs,
                         ],
@@ -355,4 +388,55 @@ class LocalBench:
                 CommitRecord(r, bytes.fromhex(d), 0.0 if k < cut else heal_t + 1.0)
                 for k, (r, d) in enumerate(lines)
             ]
-        return check(schedule, commits)
+        verdict = check(schedule, commits)
+        if self.workers:
+            verdict["availability"] = self._audit_availability(
+                logs_dir, schedule
+            )
+        return verdict
+
+    def _audit_availability(self, logs_dir: str, schedule) -> dict:
+        """The Conveyor invariant, audited end to end: every batch digest
+        any node COMMITTED must resolve from at least f+1 honest nodes'
+        on-disk stores after the run — the availability the certificate
+        promised at ordering time, checked against reality."""
+        import asyncio
+        import base64
+        import re
+
+        from hotstuff_tpu.faultline import check_availability
+        from hotstuff_tpu.store import Store
+
+        booted = self.nodes - self.faults
+        committed: set[bytes] = set()
+        for i in range(booted):
+            try:
+                with open(os.path.join(logs_dir, f"node-{i}.log")) as f:
+                    text = f.read()
+            except OSError:
+                continue
+            for b64 in re.findall(r"Committed B\d+ -> ([^ \n]+=)", text):
+                try:
+                    raw = base64.standard_b64decode(b64)
+                except ValueError:
+                    continue
+                if len(raw) == 32:
+                    committed.add(raw)
+
+        holders: dict[str, set[str]] = {d.hex(): set() for d in committed}
+
+        async def scan() -> None:
+            for i in range(booted):
+                path = os.path.join(self.work_dir, f"db_{i}")
+                if not os.path.isdir(path):
+                    continue
+                store = Store(path)
+                try:
+                    for d in committed:
+                        if await store.read(d) is not None:
+                            holders[d.hex()].add(f"n{i:03d}")
+                finally:
+                    store.close()
+
+        asyncio.run(scan())
+        return check_availability(schedule, set(holders), holders)

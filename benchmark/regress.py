@@ -184,6 +184,14 @@ def main() -> None:
     p.add_argument("--skip-protocol", action="store_true")
     p.add_argument("--skip-crypto", action="store_true")
     p.add_argument(
+        "--dataplane", type=int, default=0, metavar="RATE",
+        help="also gate the Conveyor sharded-ingest e2e TPS at this "
+        "offered rate (tx/s) against the committed dataplane sweep "
+        "artifact (offered-rate-aware floor)",
+    )
+    p.add_argument("--dataplane-workers", type=int, default=1)
+    p.add_argument("--dataplane-duration", type=int, default=15)
+    p.add_argument(
         "--pyprof", action="store_true",
         help="sample the protocol measurement and attach the top "
         "self-time functions to the artifact (a red gate then names "
@@ -192,7 +200,7 @@ def main() -> None:
     p.add_argument("--output", help="directory for the JSON artifact")
     args = p.parse_args()
 
-    if args.skip_protocol and args.skip_crypto:
+    if args.skip_protocol and args.skip_crypto and not args.dataplane:
         print("nothing to check", file=sys.stderr)
         sys.exit(2)
 
@@ -252,6 +260,45 @@ def main() -> None:
                 limit=round(limit, 2),
                 ratio=round(fresh_us / baseline["cpu_batch_us"], 3),
                 ok=fresh_us <= limit,
+            )
+        checks.append(check)
+
+    if args.dataplane:
+        from benchmark.dataplane_sweep import best_committed_tps, run_point
+
+        row = run_point(
+            args.dataplane,
+            nodes=4,
+            workers=args.dataplane_workers,
+            tx_size=512,
+            duration=args.dataplane_duration,
+            base_port=args.base_port + 5_000,
+            work_dir=".regress-dataplane",
+            batch_size=250_000,
+            max_batch_delay=50,
+            timeout=5_000,
+        )
+        check = {
+            "metric": f"dataplane_e2e_tps_{args.dataplane}offered",
+            "fresh": row["e2e_tps"],
+            "e2e_latency_ms": row["e2e_latency_ms"],
+            "shed": row["shed"],
+        }
+        baseline = best_committed_tps(os.path.join(REPO_ROOT, "results"))
+        if baseline is None:
+            check.update(status="no-baseline", ok=True)
+        else:
+            # A run cannot commit more than it offered: floor against
+            # min(committed peak, offered rate).
+            reachable = min(baseline["e2e_tps"], args.dataplane)
+            floor = reachable * (1 - args.tolerance)
+            check.update(
+                status="compared",
+                baseline=baseline["e2e_tps"],
+                baseline_source=baseline["source"],
+                floor=round(floor),
+                ratio=round(row["e2e_tps"] / reachable, 3),
+                ok=row["e2e_tps"] >= floor,
             )
         checks.append(check)
 

@@ -26,6 +26,11 @@ def main() -> None:
     p.add_argument("--base-port", type=int, default=9000)
     p.add_argument("--work-dir", default=".bench")
     p.add_argument(
+        "--workers", type=int, default=0,
+        help="Conveyor worker shards per node (0 = legacy mempool only); "
+        "clients switch to the sharded bundle load generator",
+    )
+    p.add_argument(
         "--crypto-backend",
         default="cpu",
         choices=["cpu", "tpu", "cpu-batched", "tpu-batched"],
@@ -74,6 +79,7 @@ def main() -> None:
         crypto_backend=args.crypto_backend,
         telemetry=args.telemetry,
         chaos=chaos_path,
+        workers=args.workers,
     )
     parser = bench.run()
     print(parser.result())
@@ -90,17 +96,28 @@ def main() -> None:
         import json
 
         v = bench.chaos_verdict
+        avail = v.get("availability")
         print(
             f"chaos verdict: safety="
             f"{'ok' if v['safety']['ok'] else 'VIOLATED'} liveness="
-            f"{'recovered' if v['liveness']['recovered'] else 'STALLED'} "
-            f"commits={v['commits']}"
+            f"{'recovered' if v['liveness']['recovered'] else 'STALLED'}"
+            + (
+                f" availability={'ok' if avail['ok'] else 'VIOLATED'}"
+                f" ({avail['checked']} digests @ f+1={avail['required_holders']})"
+                if avail is not None
+                else ""
+            )
+            + f" commits={v['commits']}"
         )
         out = os.path.join(os.path.abspath(args.work_dir), "chaos-verdict.json")
         with open(out, "w") as f:
             json.dump(v, f, indent=2, sort_keys=True)
         print(f"verdict written to {out}")
-        if not (v["safety"]["ok"] and v["liveness"]["recovered"]):
+        if not (
+            v["safety"]["ok"]
+            and v["liveness"]["recovered"]
+            and v.get("availability", {}).get("ok", True)
+        ):
             sys.exit(1)
 
 

@@ -50,7 +50,9 @@ def run_soak(args) -> dict:
         # (joined below into the verdict's attribution section).
         os.environ["HOTSTUFF_PYPROF"] = "1"
     chaos_path = None
-    if args.chaos_seed is not None:
+    if getattr(args, "chaos_scenario", None):
+        chaos_path = os.path.abspath(args.chaos_scenario)
+    elif args.chaos_seed is not None:
         from hotstuff_tpu.faultline import chaos_scenario
 
         scenario = chaos_scenario(
@@ -70,6 +72,7 @@ def run_soak(args) -> dict:
         work_dir=args.work_dir,
         telemetry=True,
         chaos=chaos_path,
+        workers=args.workers,
     )
     logs_dir = os.path.join(work_dir, "logs")
 
@@ -122,6 +125,17 @@ def run_soak(args) -> dict:
             timeouts_per_round=args.timeouts_per_round,
             allow_violation_fraction=args.allow_violation_fraction,
         )
+        + (
+            # Conveyor gate set: bounded worker store depth (the
+            # back-pressure contract) and zero commit-path resolution
+            # timeouts (the availability contract). Streams without the
+            # worker metrics skip these, so the flag is always safe.
+            slo_mod.dataplane_slos(
+                allow_violation_fraction=args.allow_violation_fraction
+            )
+            if args.workers
+            else []
+        )
         + slo_mod.memory_slos(
             # The unbounded-growth gate (ROADMAP item 4): RSS and store
             # disk must grow slower than the bound in every window. The
@@ -141,6 +155,9 @@ def run_soak(args) -> dict:
         chaos_ok = (
             bench.chaos_verdict["safety"]["ok"]
             and bench.chaos_verdict["liveness"]["recovered"]
+            # Conveyor availability invariant (present when workers > 0):
+            # every committed digest resolvable at f+1 honest stores.
+            and bench.chaos_verdict.get("availability", {}).get("ok", True)
         )
 
     # Resource + commit trajectory per node (first → last snapshot): the
@@ -258,6 +275,8 @@ def run_soak(args) -> dict:
             "tx_size": args.tx_size,
             "duration_s": args.duration,
             "chaos_seed": args.chaos_seed,
+            "chaos_scenario": getattr(args, "chaos_scenario", None),
+            "workers": args.workers,
             "slo_window_s": args.window,
         },
         "slo": slo_verdict,
@@ -285,6 +304,16 @@ def main() -> None:
     p.add_argument(
         "--chaos-seed", type=int, default=None,
         help="arm a seeded faultline chaos storm for the whole run",
+    )
+    p.add_argument(
+        "--chaos-scenario", default=None,
+        help="explicit faultline scenario JSON (overrides --chaos-seed); "
+        "e.g. benchmark/scenarios/worker-crash.json",
+    )
+    p.add_argument(
+        "--workers", type=int, default=0,
+        help="Conveyor worker shards per node; adds the dataplane SLO "
+        "set and the availability invariant to the verdict",
     )
     p.add_argument(
         "--window", type=float, default=15.0, help="SLO sliding window (s)"
@@ -329,9 +358,14 @@ def main() -> None:
         print(verdict["summary"])
     if args.output:
         os.makedirs(args.output, exist_ok=True)
-        tag = (
-            f"chaos{args.chaos_seed}" if args.chaos_seed is not None else "clean"
-        )
+        if getattr(args, "chaos_scenario", None):
+            tag = os.path.splitext(os.path.basename(args.chaos_scenario))[0]
+        elif args.chaos_seed is not None:
+            tag = f"chaos{args.chaos_seed}"
+        else:
+            tag = "clean"
+        if args.workers:
+            tag = f"w{args.workers}-{tag}"
         path = os.path.join(
             args.output,
             f"soak-slo-n{args.nodes}-{args.duration}s-{tag}.json",

@@ -1,10 +1,16 @@
 """Payload availability gate (reference ``consensus/src/mempool.rs``).
 
-``verify(block)`` checks every payload digest is in the store; when batches
-are missing it sends ``Synchronize`` to the mempool and parks the block in
-the PayloadWaiter, which re-injects it to the Core once all batches arrive
-(store ``notify_read`` on each missing digest). ``cleanup(round)`` propagates
-GC to the mempool and cancels stale waiters.
+``verify(block)`` checks every payload digest is AVAILABLE: either the
+batch itself is in the store, or a verified **availability certificate**
+(the Conveyor data plane's 2f+1 signed acks, stored under
+``cert_key(digest)``) proves the committee holds it — the Narwhal rule
+that lets a replica vote on a block whose batches it never received,
+keeping dissemination bandwidth off the ordering critical path. When
+neither is present it sends ``Synchronize`` to the mempool and parks the
+block in the PayloadWaiter, which re-injects it to the Core once all
+batches arrive (store ``notify_read`` on each missing digest).
+``cleanup(round)`` propagates GC to the mempool and cancels stale
+waiters.
 """
 
 from __future__ import annotations
@@ -15,6 +21,7 @@ import logging
 from hotstuff_tpu.crypto import Digest
 from hotstuff_tpu.mempool import Cleanup as MempoolCleanup
 from hotstuff_tpu.mempool import Synchronize as MempoolSynchronize
+from hotstuff_tpu.mempool.dataplane.messages import cert_key
 from hotstuff_tpu.store import Store
 
 from .config import Round
@@ -37,11 +44,18 @@ class MempoolDriver:
         self._pending: dict[Digest, tuple[Round, asyncio.Task]] = {}
 
     async def verify(self, block: Block) -> bool:
-        """True if all payload batches are local; otherwise triggers sync and
-        parks the block (reference ``mempool.rs:40-64``)."""
-        missing = [
-            d for d in block.payload if await self.store.read(d.data) is None
-        ]
+        """True if every payload batch is local OR carries a stored
+        availability certificate; otherwise triggers sync and parks the
+        block (reference ``mempool.rs:40-64``). Certificates are verified
+        against the mempool committee BEFORE they are stored (worker
+        ingress / cert formation), so presence here is proof."""
+        missing = []
+        for d in block.payload:
+            if await self.store.read(d.data) is not None:
+                continue
+            if await self.store.read(cert_key(d.data)) is not None:
+                continue  # certified available: vote without the bytes
+            missing.append(d)
         if not missing:
             return True
         await self.tx_mempool.put(MempoolSynchronize(missing, block.author))

@@ -72,6 +72,18 @@ class Proposer:
             )
             if get_digest in done:
                 self.buffer.add(get_digest.result())
+                # Greedy drain: on a CPU-saturated loop this task is
+                # scheduled far less often than digests arrive (ingest
+                # tasks are always runnable), and one-digest-per-turn
+                # let the queue backlog while proposals went out nearly
+                # empty — ordering starving behind ingest inside the
+                # event loop, the exact inversion the data plane exists
+                # to prevent. Take everything ready.
+                while True:
+                    try:
+                        self.buffer.add(self.rx_mempool.get_nowait())
+                    except asyncio.QueueEmpty:
+                        break
                 get_digest = asyncio.create_task(self.rx_mempool.get())
             if get_message in done:
                 message = get_message.result()
@@ -85,6 +97,16 @@ class Proposer:
     async def _make_block(self, round_: Round, qc: QC, tc: TC | None) -> None:
         payload = list(self.buffer)
         self.buffer.clear()
+        if telemetry.enabled():
+            # How much certified work each proposal drains, and how much
+            # is still queued upstream — the first diagnostic when
+            # ingest outruns ordering.
+            telemetry.gauge("consensus.proposer.payload_drained").set(
+                len(payload)
+            )
+            telemetry.gauge("consensus.proposer.digest_queue_depth").set(
+                self.rx_mempool.qsize()
+            )
         block = await Block.new(
             qc, tc, self.name, round_, payload, self.signature_service
         )

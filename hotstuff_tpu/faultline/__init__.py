@@ -45,6 +45,7 @@ __all__ = [
     "VERDICT_SCHEMA",
     "chaos_scenario",
     "check",
+    "check_availability",
     "install",
     "link_rng",
     "run_scenario",
@@ -54,6 +55,7 @@ __all__ = [
 _LAZY = {
     "CommitRecord": ("checker", "CommitRecord"),
     "check": ("checker", "check"),
+    "check_availability": ("checker", "check_availability"),
     "VERDICT_SCHEMA": ("checker", "VERDICT_SCHEMA"),
     "ScenarioRun": ("harness", "ScenarioRun"),
     "run_scenario": ("harness", "run_scenario"),

@@ -26,7 +26,7 @@ from __future__ import annotations
 
 from .policy import Schedule
 
-__all__ = ["CommitRecord", "check", "VERDICT_SCHEMA"]
+__all__ = ["CommitRecord", "check", "check_availability", "VERDICT_SCHEMA"]
 
 VERDICT_SCHEMA = "faultline-verdict-v1"
 
@@ -41,6 +41,55 @@ class CommitRecord:
         self.round = round_
         self.digest = digest
         self.t = t
+
+
+def check_availability(
+    schedule: Schedule,
+    committed: set,
+    holders: dict,
+    *,
+    honest: set[str] | None = None,
+) -> dict:
+    """The Conveyor data-plane invariant: consensus never commits a
+    batch digest lacking an availability certificate RESOLVABLE at f+1
+    honest nodes — i.e. after the run, every committed batch digest must
+    be held (store-resolvable) by at least f+1 honest nodes, so the
+    2f+1-signed cert it was ordered under can always be honored.
+
+    ``committed`` is the set of committed batch digests (any hashable
+    form, typically hex); ``holders`` maps each digest to the set of
+    node names whose store resolves it. ``honest`` defaults to every
+    node the schedule never marked byzantine. Returns a plain-data
+    verdict section (``{"ok", "f", "checked", "violations"}``) that
+    harnesses merge into their run verdicts.
+    """
+    byzantine = {
+        e.params["node"] for e in schedule.events if e.kind == "byzantine"
+    }
+    if honest is None:
+        honest = set(schedule.nodes) - byzantine
+    n = len(schedule.nodes)
+    f = (n - 1) // 3
+    required = f + 1
+    violations = []
+    for digest in sorted(committed):
+        holding = sorted(h for h in holders.get(digest, ()) if h in honest)
+        if len(holding) < required:
+            violations.append(
+                {
+                    "type": "unresolvable_commit",
+                    "digest": digest if isinstance(digest, str) else str(digest),
+                    "honest_holders": holding,
+                    "required": required,
+                }
+            )
+    return {
+        "ok": not violations,
+        "f": f,
+        "required_holders": required,
+        "checked": len(committed),
+        "violations": violations,
+    }
 
 
 def check(

@@ -42,7 +42,22 @@ __all__ = [
 ]
 
 #: behaviors the runtime/byzantine module knows how to drive.
-BYZANTINE_BEHAVIORS = ("equivocate", "stale_vote_flood", "silent_leader")
+#: ``batch_withhold`` is a DATA-PLANE behavior: the node receives worker
+#: batches but never signs availability acks and never serves batch
+#: requests — enacted inside the Conveyor worker handler (like
+#: silent_leader, it needs no attack actor).
+BYZANTINE_BEHAVIORS = (
+    "equivocate",
+    "stale_vote_flood",
+    "silent_leader",
+    "batch_withhold",
+)
+
+#: the pool seeded "?"-behavior draws come from. Frozen at the original
+#: three: committed chaos seeds (3, 7, the detector ground-truth corpus)
+#: must keep compiling to byte-identical schedules — new behaviors are
+#: opt-in by name, never by lottery.
+SEEDED_BEHAVIORS = BYZANTINE_BEHAVIORS[:3]
 
 _KINDS = ("crash", "restart", "partition", "link", "byzantine")
 
@@ -259,7 +274,7 @@ class Scenario:
                     "side": str(ev.get("side", "send")),
                 }
             else:  # byzantine
-                behavior = ev.get("behavior") or rng.choice(BYZANTINE_BEHAVIORS)
+                behavior = ev.get("behavior") or rng.choice(SEEDED_BEHAVIORS)
                 if behavior not in BYZANTINE_BEHAVIORS:
                     raise ValueError(f"unknown byzantine behavior {behavior!r}")
                 params = {

@@ -191,8 +191,9 @@ class FaultPlane:
             else:
                 self._behaviors.setdefault(node, set()).add(behavior)
             # Attack-task behaviors need the supervisor; silent_leader is
-            # enacted right here in the send filter.
-            if behavior != "silent_leader":
+            # enacted right here in the send filter and batch_withhold
+            # inside the Conveyor worker handler.
+            if behavior not in ("silent_leader", "batch_withhold"):
                 self._pending_actions.append(
                     {"action": "byzantine_" + ("off" if heal else "on"),
                      "node": node, "behavior": behavior}
@@ -216,6 +217,15 @@ class FaultPlane:
             "healed" if heal else "injected", kind, ev.params,
             ev.until if heal else ev.at,
         )
+
+    def behavior_active(self, node: str, behavior: str) -> bool:
+        """True while ``node`` is currently marked with ``behavior`` —
+        the query surface for behaviors enacted at their call site
+        (silent_leader in the send filter, batch_withhold in the
+        Conveyor worker handler)."""
+        self._advance()
+        active = self._behaviors.get(node)
+        return bool(active and behavior in active)
 
     def schedule_exhausted(self) -> bool:
         """True once every scheduled transition (activations AND heals)

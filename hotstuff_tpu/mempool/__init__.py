@@ -6,7 +6,7 @@ mempool-to-mempool as batches; consensus orders only 32-byte digests
 (reference ``batch_maker.rs:100-155``, ``consensus/src/messages.rs:22``).
 """
 
-from .config import Authority, Committee, Parameters
+from .config import Authority, Committee, Parameters, WorkerEntry
 from .mempool import Mempool
 from .synchronizer import Cleanup, Synchronize
 
@@ -14,6 +14,7 @@ __all__ = [
     "Authority",
     "Committee",
     "Parameters",
+    "WorkerEntry",
     "Mempool",
     "Synchronize",
     "Cleanup",

@@ -8,7 +8,7 @@ addresses per node: ``transactions_address`` for clients and
 from __future__ import annotations
 
 import logging
-from dataclasses import dataclass
+from dataclasses import dataclass, field
 
 from hotstuff_tpu.crypto import PublicKey
 
@@ -26,6 +26,21 @@ class Parameters:
     sync_retry_nodes: int = 3  # number of nodes
     batch_size: int = 500_000  # bytes
     max_batch_delay: int = 100  # ms
+    # -- Conveyor data plane (mempool/dataplane/) ---------------------------
+    # Worker shards per node: 0 disables the data plane entirely (the
+    # legacy BatchMaker path always runs); >0 spawns min(workers,
+    # committee-declared worker entries) shards, each with its own
+    # client-ingress port, peer port, bounded ingress queue and
+    # availability-cert pipeline.
+    workers: int = 0
+    # Per-worker ingress bound, in client BUNDLES (a bundle is one client
+    # frame of many transactions). Arrivals beyond it are shed with a
+    # client-visible b"Shed" reply.
+    worker_ingress_capacity: int = 512
+    # Store-depth watermarks, in sealed-but-uncommitted batches per node:
+    # sealing gates at >= high and resumes at <= low (hysteresis).
+    store_high_watermark: int = 256
+    store_low_watermark: int = 128
     # Route concurrent batch digests (SHA-512/32) through the device kernel
     # (``ops.sha512``) instead of per-batch host hashing — the BASELINE
     # config-3 regime (committee-scale digest throughput). Off by default:
@@ -44,10 +59,25 @@ class Parameters:
 
 
 @dataclass
+class WorkerEntry:
+    """One worker shard's address pair: ``transactions_address`` faces
+    clients, ``worker_address`` faces peer workers (batch dissemination,
+    acks, certs, batch requests)."""
+
+    transactions_address: tuple[str, int]
+    worker_address: tuple[str, int]
+
+
+@dataclass
 class Authority:
     stake: Stake
     transactions_address: tuple[str, int]
     mempool_address: tuple[str, int]
+    # Conveyor worker shards (optional; absent = legacy single-lane
+    # mempool). Worker ``w`` of every node disseminates to worker ``w``
+    # of every peer, so entries pair up positionally across the
+    # committee.
+    workers: list[WorkerEntry] = field(default_factory=list)
 
 
 @dataclass
@@ -84,3 +114,30 @@ class Committee:
             for pk, a in self.authorities.items()
             if pk != name
         ]
+
+    # -- Conveyor worker shards ---------------------------------------------
+
+    def workers_of(self, name: PublicKey) -> list["WorkerEntry"]:
+        a = self.authorities.get(name)
+        return a.workers if a else []
+
+    def worker_peers(
+        self, name: PublicKey, worker_id: int
+    ) -> list[tuple[PublicKey, tuple[str, int]]]:
+        """(peer, worker_address) of every OTHER node's worker shard
+        ``worker_id`` — the dissemination fan-out set for our shard
+        ``worker_id``. Peers without that shard are skipped (a mixed
+        committee degrades to the peers that have it)."""
+        return [
+            (pk, a.workers[worker_id].worker_address)
+            for pk, a in self.authorities.items()
+            if pk != name and worker_id < len(a.workers)
+        ]
+
+    def worker_address(
+        self, name: PublicKey, worker_id: int
+    ) -> tuple[str, int] | None:
+        a = self.authorities.get(name)
+        if a is None or worker_id >= len(a.workers):
+            return None
+        return a.workers[worker_id].worker_address

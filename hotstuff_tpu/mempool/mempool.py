@@ -12,10 +12,15 @@ from __future__ import annotations
 import asyncio
 import logging
 
-from hotstuff_tpu.crypto import PublicKey
+from hotstuff_tpu.crypto import PublicKey, sha512_digest
 from hotstuff_tpu.network import MessageHandler, Receiver
 from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.serde import SerdeError
+
+# Conveyor batch frames are recognizable from their first byte (tags
+# start at 16, disjoint from the legacy mempool tags) — resolved here as
+# a constant so the per-frame dispatch pays no module lookup.
+from .dataplane.messages import TAG_BATCH as _DP_TAG_BATCH
 
 from . import messages
 from .batch_maker import BatchMaker
@@ -43,11 +48,33 @@ class TxReceiverHandler(MessageHandler):
 class MempoolReceiverHandler(MessageHandler):
     """Peer messages: ACK batches then route (reference ``mempool.rs:217-245``)."""
 
-    def __init__(self, tx_processor: asyncio.Queue, tx_helper: asyncio.Queue) -> None:
+    def __init__(
+        self,
+        tx_processor: asyncio.Queue,
+        tx_helper: asyncio.Queue,
+        store: Store | None = None,
+    ) -> None:
         self.tx_processor = tx_processor
         self.tx_helper = tx_helper
+        self.store = store
 
     async def dispatch(self, writer, message: bytes) -> None:
+        if (
+            message
+            and message[0] == _DP_TAG_BATCH
+            and self.store is not None
+        ):
+            # A Conveyor worker batch served raw through the legacy sync
+            # path (the helper sends stored frames verbatim): store it
+            # under its digest — that fulfils any notify_read obligation
+            # the availability gate or the commit resolver registered.
+            # No digest is re-emitted to consensus: the batch is being
+            # fetched precisely because it is already ordered or
+            # verifying.
+            await writer.send(b"Ack")
+            digest = sha512_digest(message)
+            await self.store.write(digest.data, message)
+            return
         try:
             kind, payload = messages.decode(message)
         except SerdeError as e:
@@ -75,6 +102,7 @@ class Mempool:
         rx_consensus: asyncio.Queue,  # ConsensusMempoolMessage (Synchronize/Cleanup)
         tx_consensus: asyncio.Queue,  # batch digests out to consensus
         benchmark: bool = False,
+        signature_service=None,  # required for the Conveyor data plane
     ) -> None:
         self.name = name
         self.committee = committee
@@ -83,8 +111,10 @@ class Mempool:
         self.rx_consensus = rx_consensus
         self.tx_consensus = tx_consensus
         self.benchmark = benchmark
+        self.signature_service = signature_service
         self.tasks: list[asyncio.Task] = []
         self.receivers: list[Receiver] = []
+        self.dataplane = None  # Conveyor worker shards (spawned on demand)
 
     async def spawn(self) -> "Mempool":
         self.parameters.log()
@@ -150,7 +180,9 @@ class Mempool:
         self.receivers.append(
             await Receiver.spawn(
                 ("0.0.0.0", mp_address[1]),
-                MempoolReceiverHandler(tx_peer_processor, tx_helper),
+                MempoolReceiverHandler(
+                    tx_peer_processor, tx_helper, store=self.store
+                ),
                 auto_ack=True,
             )
         )
@@ -165,6 +197,28 @@ class Mempool:
         )
         self.tasks.append(Helper.spawn(self.committee, self.store, tx_helper))
 
+        # Conveyor data plane: worker shards with availability certs.
+        if (
+            self.parameters.workers > 0
+            and self.committee.workers_of(self.name)
+        ):
+            if self.signature_service is None:
+                raise ValueError(
+                    "the Conveyor data plane needs a signature service "
+                    "(availability acks are signed)"
+                )
+            from .dataplane import DataPlane
+
+            self.dataplane = await DataPlane(
+                self.name,
+                self.committee,
+                self.parameters,
+                self.store,
+                self.signature_service,
+                self.tx_consensus,
+                benchmark=self.benchmark,
+            ).spawn()
+
         log.info(
             "Mempool successfully booted on %s", mp_address[0]
         )
@@ -173,5 +227,7 @@ class Mempool:
     async def shutdown(self) -> None:
         for t in self.tasks:
             t.cancel()
+        if self.dataplane is not None:
+            await self.dataplane.shutdown()
         for r in self.receivers:
             await r.shutdown()

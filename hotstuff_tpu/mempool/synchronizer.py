@@ -7,6 +7,13 @@ coarse timer rebroadcasts unanswered requests after ``sync_retry_delay`` to
 ``sync_retry_nodes`` random peers via ``lucky_broadcast``
 (``synchronizer.rs:175-206``). ``Cleanup(round)`` cancels waiters older than
 ``gc_depth`` rounds (``synchronizer.rs:143-159``).
+
+Retry policy matches the consensus synchronizer's: the idle tick does
+zero work while nothing is outstanding (the steady state — the old loop
+scanned ``pending`` every second forever), and each retry RE-ARMS its
+request for a full ``sync_retry_delay`` instead of re-broadcasting on
+every tick once expired (the committee-wide duplicate-request storm the
+consensus side already fixed).
 """
 
 from __future__ import annotations
@@ -49,6 +56,7 @@ class Synchronizer:
         sync_retry_delay: int,
         sync_retry_nodes: int,
         rx_message: asyncio.Queue,
+        clock=time.monotonic,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -57,6 +65,9 @@ class Synchronizer:
         self.sync_retry_delay = sync_retry_delay / 1000.0
         self.sync_retry_nodes = sync_retry_nodes
         self.rx_message = rx_message
+        # Injectable clock (default untouched), mirroring the consensus
+        # synchronizer: retry expiry must be judgeable without sleeping.
+        self._clock = clock
         self.network = SimpleSender()
         self.round = 0
         # digest -> (round registered, waiter task, last request time)
@@ -87,10 +98,14 @@ class Synchronizer:
                     self._handle_cleanup(message.round)
             if timer in done:
                 timer = asyncio.create_task(asyncio.sleep(TIMER_RESOLUTION))
-                self._retry_expired()
+                # Idle fast path (PR 10's consensus-synchronizer fix): with
+                # nothing outstanding — the steady state — the tick does no
+                # work at all, not even a clock read.
+                if self.pending:
+                    self._retry_expired()
 
     async def _handle_synchronize(self, message: Synchronize) -> None:
-        now = time.monotonic()
+        now = self._clock()
         missing = []
         for digest in message.digests:
             if digest in self.pending:
@@ -118,13 +133,22 @@ class Synchronizer:
             _, task, _ = self.pending.pop(digest)
             task.cancel()
 
-    def _retry_expired(self) -> None:
-        now = time.monotonic()
+    def _expired(self, now: float) -> list[Digest]:
+        """Digests whose LAST request aged past ``sync_retry_delay``; each
+        is re-armed for a full delay, so one retry per window — never one
+        per poll tick (the consensus-side fix, applied here too)."""
         expired = [
             d
             for d, (_, _, ts) in self.pending.items()
             if ts + self.sync_retry_delay < now
         ]
+        for d in expired:
+            r, task, _ = self.pending[d]
+            self.pending[d] = (r, task, now)
+        return expired
+
+    def _retry_expired(self) -> None:
+        expired = self._expired(self._clock())
         if not expired:
             return
         # Best-effort gossip to a few random peers (``synchronizer.rs:190-202``).
@@ -132,6 +156,3 @@ class Synchronizer:
         self.network.lucky_broadcast(
             addresses, encode_batch_request(expired, self.name), self.sync_retry_nodes
         )
-        for d in expired:
-            r, task, _ = self.pending[d]
-            self.pending[d] = (r, task, now)

@@ -6,6 +6,15 @@ Waits for all ``--nodes`` TCP ports then 2x timeout; sends ``rate`` tx/s in
 start with byte 0 + u64 BE counter (one per burst, used for e2e latency);
 standard txs start with byte 1 + a random u64. Log lines are the
 measurement interface (``client.rs:110,128-131``).
+
+**Sharded mode** (``--shards a:p,b:p,...``): targets Conveyor worker
+ingress ports instead of the legacy transactions port. Each burst is
+pre-framed into one BUNDLE per shard (header: tx count + sample ids;
+body: opaque length-prefixed tx blob), so the per-transaction Python
+cost stays on this client and the node-side hot path handles whole
+bundles. A reader task per shard counts the node's client-visible
+``b"Shed"`` refusals (the back-pressure contract) and logs them —
+the measurement interface gains ``Shed notifications: N``.
 """
 
 from __future__ import annotations
@@ -96,6 +105,116 @@ async def run_client(
         writer.close()
 
 
+async def run_sharded_client(
+    shards: list[tuple[str, int]],
+    size: int,
+    rate: int,
+    timeout_ms: int,
+    nodes: list[tuple[str, int]],
+    duration: float | None = None,
+) -> None:
+    from hotstuff_tpu.mempool.dataplane.messages import TAG_TX_BUNDLE
+
+    log.info("Worker shards: %s", ", ".join(f"{h}:{p}" for h, p in shards))
+    # NOTE: these exact log entries are parsed by the benchmark harness.
+    log.info("Transactions size: %d B", size)
+    log.info("Transactions rate: %d tx/s", rate)
+    if size < 9:
+        raise ValueError("transaction size must be at least 9 bytes")
+    await wait_for_nodes(nodes, timeout_ms)
+
+    conns = [await asyncio.open_connection(*addr) for addr in shards]
+    shed = [0]
+
+    async def count_sheds(reader: asyncio.StreamReader) -> None:
+        last_logged = 0.0
+        try:
+            while True:
+                hdr = await reader.readexactly(4)
+                frame = await reader.readexactly(int.from_bytes(hdr, "big"))
+                if frame == b"Shed":
+                    shed[0] += 1
+                    now = time.monotonic()
+                    if now - last_logged > 1.0:
+                        last_logged = now
+                        # NOTE: measurement interface (shed accounting).
+                        log.warning("Shed notifications: %d", shed[0])
+        except (asyncio.IncompleteReadError, ConnectionError):
+            pass
+
+    readers = [asyncio.create_task(count_sheds(r)) for r, _w in conns]
+
+    burst = max(rate // PRECISION, 1)
+    per_shard = max(burst // len(conns), 1)
+    counter = 0
+    seq = random.getrandbits(63)
+    # Per-tx assembly fast path: only the 9 header bytes vary.
+    filler = b"\x01" * (size - 9)
+    prefix = size.to_bytes(4, "big") + b"\x01"
+    sample_filler = b"\x00" * (size - 9)
+
+    def bundle(n_txs: int, sample_id: int | None) -> bytes:
+        nonlocal seq
+        parts = []
+        if sample_id is not None:
+            parts.append(
+                prefix[:4] + b"\x00" + sample_id.to_bytes(8, "big") + sample_filler
+            )
+            n_txs -= 1
+        base = seq
+        seq += n_txs
+        parts.extend(
+            prefix + (base + i).to_bytes(8, "big") + filler
+            for i in range(n_txs)
+        )
+        blob = b"".join(parts)
+        # Bundle header fields ride the serde codec = little-endian; the
+        # per-tx length prefixes INSIDE the blob are big-endian (the
+        # split_blob contract). The sample id is a raw u64 field (LE).
+        head = (
+            bytes([TAG_TX_BUNDLE])
+            + (len(parts)).to_bytes(4, "little")
+            + (1 if sample_id is not None else 0).to_bytes(4, "little")
+            + (sample_id.to_bytes(8, "little") if sample_id is not None else b"")
+        )
+        return head + len(blob).to_bytes(4, "little") + blob
+
+    # NOTE: This log entry is used to compute performance.
+    log.info("Start sending transactions")
+    deadline = time.monotonic() + duration if duration else None
+    next_burst = time.monotonic()
+    try:
+        while deadline is None or time.monotonic() < deadline:
+            now = time.monotonic()
+            if now < next_burst:
+                await asyncio.sleep(next_burst - now)
+            burst_start = time.monotonic()
+            sample_shard = counter % len(conns)
+            for i, (_r, writer) in enumerate(conns):
+                sample_id = counter if i == sample_shard else None
+                if sample_id is not None:
+                    # NOTE: This log entry is used to compute performance.
+                    log.info("Sending sample transaction %d", counter)
+                frame = bundle(per_shard, sample_id)
+                writer.write(len(frame).to_bytes(4, "big") + frame)
+            for _r, writer in conns:
+                await writer.drain()
+            if time.monotonic() - burst_start > BURST_DURATION:
+                # NOTE: This log entry is used to compute performance.
+                log.warning("Transaction rate too high for this client")
+            counter += 1
+            next_burst += BURST_DURATION
+    except (ConnectionError, OSError) as e:
+        log.warning("Failed to send transaction: %s", e)
+    finally:
+        for t in readers:
+            t.cancel()
+        for _r, writer in conns:
+            writer.close()
+        if shed[0]:
+            log.warning("Shed notifications: %d", shed[0])
+
+
 def _parse_addr(s: str) -> tuple[str, int]:
     host, _, port = s.rpartition(":")
     return (host, int(port))
@@ -109,8 +228,26 @@ def main() -> None:
     parser.add_argument("--timeout", type=int, required=True, help="node timeout (ms)")
     parser.add_argument("--nodes", nargs="*", default=[], help="addresses to await")
     parser.add_argument("--duration", type=float, default=None, help="stop after N s")
+    parser.add_argument(
+        "--shards",
+        default=None,
+        help="comma-separated Conveyor worker ingress addresses; switches "
+        "to sharded bundle mode (the positional target is ignored)",
+    )
     args = parser.parse_args()
     setup_logging(2)
+    if args.shards:
+        asyncio.run(
+            run_sharded_client(
+                [_parse_addr(a) for a in args.shards.split(",")],
+                args.size,
+                args.rate,
+                args.timeout,
+                [_parse_addr(a) for a in args.nodes],
+                duration=args.duration,
+            )
+        )
+        return
     asyncio.run(
         run_client(
             _parse_addr(args.target),

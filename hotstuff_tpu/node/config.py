@@ -26,6 +26,7 @@ from hotstuff_tpu.crypto import PublicKey, SecretKey, generate_keypair
 from hotstuff_tpu.mempool import Authority as MempoolAuthority
 from hotstuff_tpu.mempool import Committee as MempoolCommittee
 from hotstuff_tpu.mempool import Parameters as MempoolParameters
+from hotstuff_tpu.mempool import WorkerEntry
 
 
 class ConfigError(Exception):
@@ -106,6 +107,17 @@ class Committee:
                         stake=int(a["stake"]),
                         transactions_address=_parse_addr(a["transactions_address"]),
                         mempool_address=_parse_addr(a["mempool_address"]),
+                        # Conveyor worker shards: optional, so committee
+                        # files from the reference harness parse unchanged.
+                        workers=[
+                            WorkerEntry(
+                                transactions_address=_parse_addr(
+                                    w["transactions_address"]
+                                ),
+                                worker_address=_parse_addr(w["worker_address"]),
+                            )
+                            for w in a.get("workers", [])
+                        ],
                     )
                     for a in data["mempool"]["authorities"].values()
                 },
@@ -135,6 +147,26 @@ class Committee:
                         "stake": a.stake,
                         "transactions_address": _fmt_addr(a.transactions_address),
                         "mempool_address": _fmt_addr(a.mempool_address),
+                        # Emitted only when shards exist: files stay
+                        # byte-compatible with the reference harness
+                        # whenever the data plane is off.
+                        **(
+                            {
+                                "workers": [
+                                    {
+                                        "transactions_address": _fmt_addr(
+                                            w.transactions_address
+                                        ),
+                                        "worker_address": _fmt_addr(
+                                            w.worker_address
+                                        ),
+                                    }
+                                    for w in a.workers
+                                ]
+                            }
+                            if a.workers
+                            else {}
+                        ),
                     }
                     for pk, a in self.mempool.authorities.items()
                 },
@@ -183,6 +215,14 @@ class Parameters:
                     batch_size=int(m.get("batch_size", 500_000)),
                     max_batch_delay=int(m.get("max_batch_delay", 100)),
                     device_batch_digests=bool(m.get("device_batch_digests", False)),
+                    workers=int(m.get("workers", 0)),
+                    worker_ingress_capacity=int(
+                        m.get("worker_ingress_capacity", 512)
+                    ),
+                    store_high_watermark=int(
+                        m.get("store_high_watermark", 256)
+                    ),
+                    store_low_watermark=int(m.get("store_low_watermark", 128)),
                 ),
             )
         except (OSError, ValueError) as e:
@@ -207,6 +247,10 @@ class Parameters:
                 "batch_size": self.mempool.batch_size,
                 "max_batch_delay": self.mempool.max_batch_delay,
                 "device_batch_digests": self.mempool.device_batch_digests,
+                "workers": self.mempool.workers,
+                "worker_ingress_capacity": self.mempool.worker_ingress_capacity,
+                "store_high_watermark": self.mempool.store_high_watermark,
+                "store_low_watermark": self.mempool.store_low_watermark,
             },
         }
         with open(path, "w") as f:

@@ -53,6 +53,10 @@ def _install_faultline_from_env(committee: Committee, name) -> None:
         consensus_addrs.add(tuple(auth.address))
     for pk, auth in committee.mempool.authorities.items():
         addr_to_node[tuple(auth.mempool_address)] = names[pk]
+        for w in auth.workers:
+            # Conveyor worker ports: partitions/link faults apply to the
+            # data plane's dissemination traffic too.
+            addr_to_node[tuple(w.worker_address)] = names[pk]
     scenario = Scenario.load(scenario_path)
     schedule = scenario.compile(sorted(names.values()))
     plane = FaultPlane(schedule, addr_to_node, consensus_addrs)
@@ -71,6 +75,7 @@ class Node:
         self.consensus: Consensus | None = None
         self.store: Store | None = None
         self.telemetry_emitter: telemetry.TelemetryEmitter | None = None
+        self.resolver_task: asyncio.Task | None = None  # Conveyor commit path
         self.crashed = False
         self._boot: tuple | None = None  # (secret, committee, parameters, benchmark)
 
@@ -109,8 +114,27 @@ class Node:
             tx_consensus_to_mempool,
             tx_mempool_to_consensus,
             benchmark=benchmark,
+            signature_service=signature_service,
         )
         await self.mempool.spawn()
+
+        # Conveyor commit path: consensus ordered digests it could prove
+        # available, so committed blocks pass through the resolver (which
+        # materializes any batch this node never received) before the
+        # application sees them.
+        commit_sink = self.commit
+        if self.mempool.dataplane is not None:
+            from hotstuff_tpu.mempool.dataplane import CommitResolver
+
+            inner: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+            self.resolver_task = CommitResolver.spawn(
+                self.store,
+                inner,
+                self.commit,
+                tx_consensus_to_mempool,
+                self.mempool.dataplane,
+            )
+            commit_sink = inner
 
         self.consensus = await Consensus.spawn(
             secret.name,
@@ -120,7 +144,7 @@ class Node:
             self.store,
             tx_mempool_to_consensus,
             tx_consensus_to_mempool,
-            self.commit,
+            commit_sink,
             benchmark=benchmark,
         )
 
@@ -208,9 +232,14 @@ class Node:
         if self.mempool is not None:
             for t in self.mempool.tasks:
                 t.cancel()
+            if self.mempool.dataplane is not None:
+                await self.mempool.dataplane.shutdown()
             for r in self.mempool.receivers:
                 await r.shutdown()
             self.mempool = None
+        if self.resolver_task is not None:
+            self.resolver_task.cancel()
+            self.resolver_task = None
         self.crashed = True
         telemetry.counter("faultline.injected.crashes").inc()
         if telemetry.enabled() and self._boot is not None:
@@ -245,8 +274,22 @@ class Node:
             tx_consensus_to_mempool,
             tx_mempool_to_consensus,
             benchmark=benchmark,
+            signature_service=signature_service,
         )
         await self.mempool.spawn()
+        commit_sink = self.commit
+        if self.mempool.dataplane is not None:
+            from hotstuff_tpu.mempool.dataplane import CommitResolver
+
+            inner: asyncio.Queue = asyncio.Queue(CHANNEL_CAPACITY)
+            self.resolver_task = CommitResolver.spawn(
+                self.store,
+                inner,
+                self.commit,
+                tx_consensus_to_mempool,
+                self.mempool.dataplane,
+            )
+            commit_sink = inner
         self.consensus = await Consensus.spawn(
             secret.name,
             committee.consensus,
@@ -255,7 +298,7 @@ class Node:
             self.store,
             tx_mempool_to_consensus,
             tx_consensus_to_mempool,
-            self.commit,
+            commit_sink,
             benchmark=benchmark,
         )
         self.crashed = False
@@ -268,6 +311,8 @@ class Node:
             await self.consensus.shutdown()
         if self.mempool is not None:
             await self.mempool.shutdown()
+        if self.resolver_task is not None:
+            self.resolver_task.cancel()
         if self.telemetry_emitter is not None:
             await self.telemetry_emitter.shutdown()
         if self.store is not None:

@@ -150,6 +150,36 @@ def default_slos(
     ]
 
 
+def dataplane_slos(
+    *,
+    worker_store_depth: float = 512.0,
+    allow_violation_fraction: float = 0.0,
+) -> list[SloSpec]:
+    """The Conveyor data-plane gate set. Streams without the worker
+    metrics (data plane off) skip these specs entirely.
+
+    - ``worker_store_depth`` — sealed-but-uncommitted batches per node
+      must stay bounded (the watermark should gate sealing well before
+      this trips; a breach means back-pressure is broken, the
+      queue-collapse failure mode this plane exists to prevent);
+    - ``resolver_unresolved`` — the commit path must NEVER time out
+      resolving a certified digest to its batch (max 0 per second: one
+      occurrence is an availability violation, not degradation).
+    """
+    return [
+        SloSpec(
+            "worker_store_depth", "gauge_max",
+            "mempool.worker.store_depth", max=worker_store_depth,
+            allow_violation_fraction=allow_violation_fraction,
+        ),
+        SloSpec(
+            "resolver_unresolved", "rate",
+            "mempool.resolver.unresolved", max=0.0,
+            allow_violation_fraction=0.0,
+        ),
+    ]
+
+
 def memory_slos(
     *,
     rss_growth_bytes_per_s: float = 8 * 1024 * 1024,

@@ -298,6 +298,8 @@ class Watchtower:
         # Per-stream state: wall-clock anchors and resource history.
         self._anchors: dict[str, float] = {}  # source -> wall-mono offset
         self._resources: dict[str, deque] = {}  # node -> (ts, pid, gauges)
+        # Conveyor worker health per stream node (latest snapshot wins).
+        self._worker_stats: dict[str, dict] = {}
         self._meta: dict[str, dict] = {}
 
     # -- ingestion -----------------------------------------------------------
@@ -437,6 +439,31 @@ class Watchtower:
             self._now = ts
         node = snap.get("node") or source
         gauges = snap.get("gauges") or {}
+        # Conveyor data-plane health per node: store depth + shed/cert
+        # counters feed the scoreboard's dataplane section, so an SLO
+        # breach under load names which node's workers were drowning.
+        counters = snap.get("counters") or {}
+        worker: dict[str, float] = {}
+        for key, label in (
+            ("mempool.worker.store_depth", "store_depth"),
+            ("mempool.worker.ingress_depth", "ingress_depth"),
+        ):
+            v = gauges.get(key)
+            if isinstance(v, (int, float)):
+                worker[label] = v
+        for key, label in (
+            ("mempool.worker.ingress_tx", "ingress_tx"),
+            ("mempool.worker.shed_tx", "shed_tx"),
+            ("mempool.worker.batches_sealed", "batches_sealed"),
+            ("mempool.worker.certs_formed", "certs_formed"),
+            ("mempool.worker.throttle_events", "throttle_events"),
+            ("mempool.resolver.unresolved", "resolver_unresolved"),
+        ):
+            v = counters.get(key)
+            if isinstance(v, (int, float)):
+                worker[label] = v
+        if worker:
+            self._worker_stats[node] = worker
         tracked = {
             k: gauges[k]
             for k in ("resource.rss_bytes", "resource.store_bytes")
@@ -876,12 +903,20 @@ class Watchtower:
                 "alerts": accusations.get(name, 0),
                 "score": round(max(0.0, score), 3),
             }
-        return {
+        result = {
             "frontier": frontier,
             "windows": len(wins),
             "rounds": n_rounds,
             "peers": board,
         }
+        if self._worker_stats:
+            # Data-plane section, keyed by telemetry stream node (worker
+            # metrics ride snapshots, not the per-peer trace events).
+            result["dataplane"] = {
+                node: dict(stats)
+                for node, stats in sorted(self._worker_stats.items())
+            }
+        return result
 
 
 class AlertCapture:

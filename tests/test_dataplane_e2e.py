@@ -1,0 +1,154 @@
+"""Conveyor e2e: a full 4-node in-process committee with worker shards —
+client bundles flow worker ingress → batch dissemination → availability
+cert → consensus (ordering certified digests) → commit-path resolution,
+and every node ends the round-trip holding both the batch and its
+certificate."""
+
+import asyncio
+
+from hotstuff_tpu.consensus import Authority as CAuth
+from hotstuff_tpu.consensus import Committee as CCommittee
+from hotstuff_tpu.consensus import Parameters as CParams
+from hotstuff_tpu.mempool import Authority as MAuth
+from hotstuff_tpu.mempool import Committee as MCommittee
+from hotstuff_tpu.mempool import Parameters as MParams
+from hotstuff_tpu.mempool import WorkerEntry
+from hotstuff_tpu.mempool.dataplane import (
+    AvailabilityCert,
+    WorkerSeatTable,
+    cert_key,
+)
+from hotstuff_tpu.mempool.dataplane import messages as dpm
+from hotstuff_tpu.network.receiver import write_frame
+from hotstuff_tpu.node import Committee, Node, Parameters, Secret
+
+from .common import async_test, next_payload_commit
+
+BASE = 31700
+
+
+def _write_worker_testbed(tmp_path, base_port, n=4, workers=1):
+    secrets = [Secret.new() for _ in range(n)]
+    consensus = CCommittee(
+        authorities={
+            s.name: CAuth(stake=1, address=("127.0.0.1", base_port + i))
+            for i, s in enumerate(secrets)
+        }
+    )
+    mempool = MCommittee(
+        authorities={
+            s.name: MAuth(
+                stake=1,
+                transactions_address=("127.0.0.1", base_port + 20 + i),
+                mempool_address=("127.0.0.1", base_port + 40 + i),
+                workers=[
+                    WorkerEntry(
+                        transactions_address=(
+                            "127.0.0.1",
+                            base_port + 60 + 20 * w + i,
+                        ),
+                        worker_address=(
+                            "127.0.0.1",
+                            base_port + 160 + 20 * w + i,
+                        ),
+                    )
+                    for w in range(workers)
+                ],
+            )
+            for i, s in enumerate(secrets)
+        }
+    )
+    committee_file = str(tmp_path / "committee.json")
+    Committee(consensus, mempool).write(committee_file)
+    params_file = str(tmp_path / "parameters.json")
+    Parameters(
+        CParams(timeout_delay=2_000),
+        MParams(batch_size=200, max_batch_delay=50, workers=workers),
+    ).write(params_file)
+    key_files = []
+    for i, s in enumerate(secrets):
+        kf = str(tmp_path / f"node_{i}.json")
+        s.write(kf)
+        key_files.append(kf)
+    return committee_file, params_file, key_files
+
+
+def test_worker_committee_config_roundtrips(tmp_path):
+    committee_file, params_file, _ = _write_worker_testbed(
+        tmp_path, BASE, workers=2
+    )
+    committee = Committee.read(committee_file)
+    for pk in committee.mempool.authorities:
+        entries = committee.mempool.workers_of(pk)
+        assert len(entries) == 2
+        assert committee.mempool.worker_address(pk, 1) is not None
+        assert len(committee.mempool.worker_peers(pk, 0)) == 3
+    params = Parameters.read(params_file)
+    assert params.mempool.workers == 2
+    assert params.mempool.store_high_watermark == 256
+
+
+@async_test(timeout=90)
+async def test_four_node_committee_round_trip_over_workers(tmp_path):
+    committee_file, params_file, key_files = _write_worker_testbed(
+        tmp_path, BASE + 300
+    )
+    nodes = []
+    for i, kf in enumerate(key_files):
+        nodes.append(
+            await Node.new(
+                committee_file,
+                kf,
+                str(tmp_path / f"db_{i}"),
+                parameters_file=params_file,
+            )
+        )
+    assert all(n.mempool.dataplane is not None for n in nodes)
+    assert all(n.resolver_task is not None for n in nodes)
+
+    # A client bundle to node 0's worker-0 ingress (crosses batch_size
+    # -> immediate seal).
+    committee = Committee.read(committee_file)
+    name0 = Secret.read(key_files[0]).name
+    entry = committee.mempool.workers_of(name0)[0]
+    _, writer = await asyncio.open_connection(
+        "127.0.0.1", entry.transactions_address[1]
+    )
+    payload_tx = b"\x00" + (7).to_bytes(8, "big") + b"\xab" * 250
+    write_frame(writer, dpm.encode_bundle([payload_tx]))
+    await writer.drain()
+
+    blocks = await asyncio.wait_for(
+        asyncio.gather(*[next_payload_commit(n) for n in nodes]), 60
+    )
+    digests = {b.digest() for b in blocks}
+    assert len(digests) == 1, "nodes committed different payload blocks"
+    batch_digest = blocks[0].payload[0]
+
+    # Commit-path resolution: after the resolver releases the block,
+    # EVERY node's store materializes the batch...
+    seats = WorkerSeatTable.for_committee(committee.mempool)
+    for node in nodes:
+        raw = await asyncio.wait_for(
+            node.store.notify_read(batch_digest.data), 20
+        )
+        wid, n_txs, samples, blob = dpm.decode_worker_batch(raw)
+        assert payload_tx in dpm.split_blob(blob)
+        assert samples == [7]
+    # ...and the availability certificate that let consensus order it is
+    # present and valid wherever it was needed (author formed it, peers
+    # received the broadcast).
+    certs_seen = 0
+    for node in nodes:
+        cert_bytes = await node.store.read(cert_key(batch_digest.data))
+        if cert_bytes is None:
+            continue
+        cert = AvailabilityCert.decode(cert_bytes, seats)
+        assert cert.digest == batch_digest
+        cert.verify(committee.mempool)
+        certs_seen += 1
+    assert certs_seen >= 3  # author + at least the cert-broadcast majority
+
+    writer.close()
+    for n in nodes:
+        await n.shutdown()

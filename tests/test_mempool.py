@@ -165,6 +165,65 @@ async def test_synchronizer_cleanup_cancels_old_waiters():
     task.cancel()
 
 
+def test_synchronizer_retry_rearms_per_delay():
+    """A retried request re-arms for a full sync_retry_delay: subsequent
+    ticks inside the window do NOT re-broadcast (the consensus-side PR 10
+    fix, aligned here)."""
+    s = Synchronizer.__new__(Synchronizer)
+    s.sync_retry_delay = 2.0
+    d = sha512_digest(b"missing")
+    s.pending = {d: (0, None, 0.0)}
+    assert s._expired(1.0) == []  # not expired yet
+    assert s._expired(2.5) == [d]  # expired: retry once
+    # Re-armed: ticks inside the new delay window are quiet.
+    assert s._expired(3.0) == []
+    assert s._expired(4.0) == []
+    assert s._expired(5.0) == [d]  # a full delay later
+
+
+@async_test
+async def test_synchronizer_idle_tick_does_zero_work():
+    """With no outstanding requests the timer tick touches neither the
+    clock nor the network; once a request expires, exactly one retry
+    broadcast goes out per retry window."""
+    import hotstuff_tpu.mempool.synchronizer as sync_mod
+
+    committee = mempool_committee(BASE + 70)
+    name = keys()[0][0]
+    clock_reads = [0]
+
+    def clock():
+        clock_reads[0] += 1
+        return 1000.0
+
+    sync = Synchronizer(
+        name, committee, Store(), 50, 1_000, 3, asyncio.Queue(), clock=clock
+    )
+    sent = []
+    sync.network = type(
+        "Net", (), {
+            "send": lambda self, a, d: sent.append(("send", a)),
+            "lucky_broadcast": lambda self, addrs, d, n: sent.append(
+                ("lucky", n)
+            ),
+        },
+    )()
+    old = sync_mod.TIMER_RESOLUTION
+    sync_mod.TIMER_RESOLUTION = 0.02
+    task = asyncio.create_task(sync._run())
+    try:
+        await asyncio.sleep(0.15)  # several idle ticks
+        assert sent == [] and clock_reads[0] == 0
+        # One expired request: exactly one re-broadcast per retry window
+        # (the clock is frozen, so the re-armed entry never re-expires).
+        sync.pending[sha512_digest(b"want")] = (0, None, 0.0)
+        await asyncio.sleep(0.15)
+        assert sent == [("lucky", 3)], sent
+    finally:
+        sync_mod.TIMER_RESOLUTION = old
+        task.cancel()
+
+
 @async_test
 async def test_helper_serves_batches():
     committee = mempool_committee(BASE + 50)

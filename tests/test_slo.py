@@ -277,3 +277,41 @@ def test_memory_slos_default_set():
     names = [s.name for s in specs]
     assert names == ["rss_growth_bytes_per_s", "store_growth_bytes_per_s"]
     assert all(s.kind == "gauge_growth" for s in specs)
+
+
+def test_dataplane_slos_gate_depth_and_unresolved():
+    specs = slo.dataplane_slos(worker_store_depth=100.0)
+    assert [s.name for s in specs] == [
+        "worker_store_depth", "resolver_unresolved",
+    ]
+    # Bounded depth + zero resolution timeouts: green.
+    ok_snaps = [
+        _snap(0, counters={"mempool.resolver.unresolved": 0},
+              gauges={"mempool.worker.store_depth": 10}),
+        _snap(10, counters={"mempool.resolver.unresolved": 0},
+              gauges={"mempool.worker.store_depth": 40}),
+    ]
+    assert slo.evaluate(ok_snaps, specs, window_s=5.0)["ok"] is True
+    # Depth breach: the back-pressure failure mode is flagged.
+    deep = [
+        _snap(0, gauges={"mempool.worker.store_depth": 10}),
+        _snap(10, gauges={"mempool.worker.store_depth": 500}),
+    ]
+    verdict = slo.evaluate(deep, specs, window_s=5.0)
+    assert verdict["ok"] is False
+    assert verdict["slos"][0]["ok"] is False
+    # A single resolution timeout is an availability violation.
+    timeouts = [
+        _snap(0, counters={"mempool.resolver.unresolved": 0}),
+        _snap(10, counters={"mempool.resolver.unresolved": 1}),
+    ]
+    verdict = slo.evaluate(timeouts, specs, window_s=5.0)
+    assert verdict["ok"] is False
+
+
+def test_dataplane_slos_skip_when_plane_absent():
+    specs = slo.dataplane_slos()
+    snaps = [_snap(0), _snap(10)]
+    verdict = slo.evaluate(snaps, specs, window_s=5.0)
+    assert verdict["ok"] is True
+    assert all(s["windows"] == 0 for s in verdict["slos"])

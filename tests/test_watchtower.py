@@ -496,3 +496,34 @@ def test_chaos_seed_7_withholding_signature_detected():
     byz = next(i for i in report["incidents"] if i["kind"] == "byzantine")
     assert byz["peer"] == "n003"
     assert byz["detected"] and byz["ttd_s"] is not None
+
+
+def test_scoreboard_surfaces_dataplane_worker_stats():
+    """Worker gauges/counters riding a node's snapshot stream land in
+    the scoreboard's `dataplane` section, keyed by stream node."""
+    wt = Watchtower(config=WatchtowerConfig())
+    snap = {
+        "schema": "hotstuff-telemetry-v1",
+        "node": "n1",
+        "pid": 7,
+        "seq": 0,
+        "ts": 1.0,
+        "final": False,
+        "counters": {
+            "mempool.worker.ingress_tx": 1000,
+            "mempool.worker.shed_tx": 25,
+            "mempool.worker.certs_formed": 12,
+            "mempool.resolver.unresolved": 0,
+        },
+        "gauges": {"mempool.worker.store_depth": 17},
+        "histograms": {},
+    }
+    wt.ingest_record(snap, source="n1")
+    board = wt.scoreboard()
+    assert board["dataplane"]["n1"]["store_depth"] == 17
+    assert board["dataplane"]["n1"]["shed_tx"] == 25
+    assert board["dataplane"]["n1"]["certs_formed"] == 12
+    # Streams without worker metrics contribute no dataplane section.
+    wt2 = Watchtower(config=WatchtowerConfig())
+    wt2.ingest_record({**snap, "counters": {}, "gauges": {}}, source="n1")
+    assert "dataplane" not in wt2.scoreboard()
