@@ -319,6 +319,15 @@ def main() -> None:
     p.add_argument("--mode", choices=["protocol", "crypto"], default="protocol")
     p.add_argument("--tc-heavy", action="store_true")
     p.add_argument(
+        "--groups",
+        type=int,
+        default=None,
+        help="protocol mode: shard the committee across this many worker "
+        "processes (engine groups, hotstuff_tpu/parallel/engine_groups.py). "
+        "Default: HOTSTUFF_ENGINE_GROUPS (0 = single-process, the "
+        "byte-identical classic path)",
+    )
+    p.add_argument(
         "--faults",
         metavar="SCENARIO",
         help="run a faultline scenario (a JSON file, chaos:<seed> for a "
@@ -469,7 +478,29 @@ def main() -> None:
         )
         capture.watchtower = watch.watch
         watch.start()
-    if args.mode == "protocol":
+    from hotstuff_tpu.parallel.engine_groups import groups_from_env
+
+    n_groups = args.groups if args.groups is not None else groups_from_env()
+    if args.mode == "protocol" and n_groups >= 1:
+        # Process-sharded committee: the parent only consumes decision
+        # records from the groups' event rings (no engines, no crypto in
+        # this process). Incompatible with the in-process observability
+        # attachments (--profile/--telemetry/--pyprof/--watch), which
+        # assume the engines share the parent's registry.
+        if args.profile or args.telemetry or profiler is not None or watch:
+            print(
+                "--groups is incompatible with --profile/--telemetry/"
+                "--pyprof/--watch (engines run in worker processes)",
+                file=sys.stderr,
+            )
+            sys.exit(2)
+        from hotstuff_tpu.parallel.engine_groups import run_grouped_committee
+
+        per_round, _merged = run_grouped_committee(
+            args.nodes, args.rounds, n_groups,
+            base_port=args.base_port, timeout_delay=args.timeout,
+        )
+    elif args.mode == "protocol":
         try:
             per_round, stage_profile = asyncio.run(
                 run_committee(
@@ -496,7 +527,9 @@ def main() -> None:
     )
     line = (
         f"committee={args.nodes} (f={f}, QC size {2 * f + 1}) mode={args.mode}"
-        f"{' tc-heavy' if args.tc_heavy else ''} backend={backend}"
+        f"{' tc-heavy' if args.tc_heavy else ''}"
+        f"{f' groups={n_groups}' if args.mode == 'protocol' and n_groups else ''}"
+        f" backend={backend}"
         f" transport={transport}: "
         f"{per_round * 1e3:.1f} ms/round ({1 / per_round:.2f} rounds/s)"
     )

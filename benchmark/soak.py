@@ -298,6 +298,11 @@ def main() -> None:
     p.add_argument("--rate", type=int, default=500, help="total input tx/s")
     p.add_argument("--tx-size", type=int, default=512)
     p.add_argument("--duration", type=int, default=60, help="soak seconds")
+    p.add_argument(
+        "--hours", type=float, default=None,
+        help="convenience: soak length in hours (overrides --duration); "
+        "the ROADMAP 3c long-soak artifacts use --hours 1",
+    )
     p.add_argument("--timeout", type=int, default=1_000, help="consensus ms")
     p.add_argument("--base-port", type=int, default=9400)
     p.add_argument("--work-dir", default=".soak")
@@ -350,6 +355,8 @@ def main() -> None:
     )
     p.add_argument("--output", help="directory for the verdict artifact")
     args = p.parse_args()
+    if args.hours is not None:
+        args.duration = int(args.hours * 3600)
 
     verdict = run_soak(args)
     print(json.dumps({k: v for k, v in verdict.items() if k != "summary"},

@@ -1,0 +1,124 @@
+"""Process-wide certificate-verdict arena: each distinct certificate is
+fully verified once per process per committee.
+
+Why this is legitimate where the opt-in ``crypto._VERIFY_MEMO`` is not:
+the live superbatch plane (``crypto/batching.py``) already prices the N
+in-process copies of one rebroadcast QC at ONE inner MSM whenever their
+verify requests pool in a fused window — documented there as the big win
+under contention. That dedup is *timing-dependent*: whether node 700's
+copy fuses with node 3's depends on flush scheduling, so at N=1000 a
+round pays one MSM or several for the same cert depending on jitter. For
+AGGREGATE certificates (wire-v2 bitmap + packed signature buffer, one
+fused RLC statement per cert — see ``crypto.backend_verify_cert``) this
+arena makes that existing cross-node dedup deterministic: the first
+verifier pays the MSM, every later in-process arrival of the same cert
+under the same committee hits the arena. It also models the deployment
+the paper's linear-authenticator direction targets: with a threshold/
+aggregate authenticator each replica verifies ONE aggregate check per
+cert, so the per-replica cost the testbed skips on a hit is the O(1)
+aggregate check, not 2f+1 per-signature verifications. The committed
+benchmark rows name the configuration; ``HOTSTUFF_CERT_ARENA=0`` is the
+kill-switch for A/B runs where every node must pay its own verify (the
+equivalence tests run both ways).
+
+Success-only: failed certs are NOT cached — a byzantine cert re-raises on
+every arrival, byte-for-byte the per-node behavior (and the per-node
+``CertificateCache`` never caches failures either). Keyed by
+(committee fingerprint, canonical cert key): the same bytes verified
+under different committees (tests) must not alias, and the canonical key
+is shared across wire formats so a v1 and v2 copy of one cert hit the
+same entry.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import threading
+from collections import OrderedDict
+
+from hotstuff_tpu import telemetry
+
+
+def enabled() -> bool:
+    """Read per call so tests and operators can flip the switch live."""
+    return os.environ.get("HOTSTUFF_CERT_ARENA", "1") != "0"
+
+
+def committee_fp(committee) -> bytes:
+    """Stable fingerprint of a committee's verification-relevant state:
+    sorted (key, stake) pairs plus the quorum threshold. Memoized on the
+    committee object — membership is fixed per epoch (parity with the
+    reference's static committees)."""
+    fp = getattr(committee, "_cert_arena_fp", None)
+    if fp is None:
+        h = hashlib.sha256()
+        for pk in sorted(committee.authorities):
+            h.update(pk.data)
+            h.update(committee.authorities[pk].stake.to_bytes(8, "little"))
+        h.update(committee.quorum_threshold().to_bytes(8, "little"))
+        fp = h.digest()
+        try:
+            committee._cert_arena_fp = fp
+        except AttributeError:
+            pass  # slotted/frozen committee variants just re-hash
+    return fp
+
+
+class CertArena:
+    """Bounded LRU of successfully-verified certificate identities."""
+
+    def __init__(self, cap: int = 8192) -> None:
+        self.cap = cap
+        self.hits = 0
+        self.misses = 0
+        self._seen: "OrderedDict[tuple, None]" = OrderedDict()
+        # hit()/add() run on crypto worker threads from every engine.
+        self._lock = threading.Lock()
+        self._m_hits = telemetry.counter("consensus.cert_arena.hits")
+        self._m_misses = telemetry.counter("consensus.cert_arena.misses")
+
+    def hit(self, key: tuple) -> bool:
+        with self._lock:
+            if key in self._seen:
+                self._seen.move_to_end(key)
+                self.hits += 1
+                self._m_hits.inc()
+                return True
+            self.misses += 1
+            self._m_misses.inc()
+            return False
+
+    def add(self, key: tuple) -> None:
+        with self._lock:
+            self._seen[key] = None
+            self._seen.move_to_end(key)
+            while len(self._seen) > self.cap:
+                self._seen.popitem(last=False)
+
+    def clear(self) -> None:
+        with self._lock:
+            self._seen.clear()
+
+
+_ARENA: CertArena | None = None
+_ARENA_LOCK = threading.Lock()
+
+
+def get_arena() -> CertArena | None:
+    """The process singleton, or None when disabled."""
+    if not enabled():
+        return None
+    global _ARENA
+    if _ARENA is None:
+        with _ARENA_LOCK:
+            if _ARENA is None:
+                _ARENA = CertArena()
+    return _ARENA
+
+
+def reset() -> None:
+    """Drop the singleton (tests: isolate arena state between cases)."""
+    global _ARENA
+    with _ARENA_LOCK:
+        _ARENA = None

@@ -26,12 +26,12 @@ from hotstuff_tpu.crypto import (
     PublicKey,
     SecretKey,
     Signature,
-    backend_verify_batch,
+    backend_verify_cert,
     sha512_digest,
 )
 from hotstuff_tpu.utils.serde import MAX_LEN, Decoder, Encoder, SerdeError
 
-from . import errors
+from . import cert_arena, errors
 from .config import Committee, Round
 
 _U32 = struct.Struct("<I")
@@ -301,6 +301,17 @@ class QC:
             key = CertificateCache.key_of(self)
             if cache.hit(key):
                 return
+        arena = cert_arena.get_arena()
+        akey = None
+        if arena is not None:
+            akey = (
+                cert_arena.committee_fp(committee),
+                key if key is not None else CertificateCache.key_of(self),
+            )
+            if arena.hit(akey):
+                if cache is not None:
+                    cache.add(key)
+                return
         raw = None
         if "votes" not in self.__dict__:
             raw = self.__dict__.get("_raw_votes")
@@ -325,6 +336,8 @@ class QC:
                 raise  # infrastructure failure, NOT a byzantine signature
             except CryptoError as e:
                 raise errors.InvalidSignature(str(e)) from e
+        if arena is not None:
+            arena.add(akey)
         if cache is not None:
             cache.add(key)
 
@@ -346,10 +359,16 @@ class QC:
             raise errors.QCRequiresQuorum("QC requires a quorum")
         digest = self.digest()
         try:
-            backend_verify_batch(
-                [digest.data] * len(seat_list),
+            # ONE fused job per cert: the crypto plane receives the packed
+            # signature buffer + stride, never 2f+1 sliced objects; the
+            # canonical cert key lets the superbatch dedup concurrent
+            # verifies of this cert across in-process nodes.
+            backend_verify_cert(
+                digest.data,
                 [keys[s].data for s in seat_list],
-                [sig_buf[i * 64 : i * 64 + 64] for i in range(len(seat_list))],
+                sig_buf,
+                64,
+                key=CertificateCache.key_of(self),
             )
         except BackendUnavailable:
             raise  # infrastructure failure, NOT a byzantine signature
@@ -511,6 +530,17 @@ class TC:
             key = CertificateCache.key_of(self)
             if cache.hit(key):
                 return
+        arena = cert_arena.get_arena()
+        akey = None
+        if arena is not None:
+            akey = (
+                cert_arena.committee_fp(committee),
+                key if key is not None else CertificateCache.key_of(self),
+            )
+            if arena.hit(akey):
+                if cache is not None:
+                    cache.add(key)
+                return
         raw = None
         if "votes" not in self.__dict__:
             raw = self.__dict__.get("_raw_votes")
@@ -546,6 +576,8 @@ class TC:
                 raise  # infrastructure failure, NOT a byzantine signature
             except CryptoError as e:
                 raise errors.InvalidSignature(str(e)) from e
+        if arena is not None:
+            arena.add(akey)
         if cache is not None:
             cache.add(key)
 
@@ -566,13 +598,17 @@ class TC:
             raise errors.TCRequiresQuorum("TC requires a quorum")
         round_le = _U64.pack(self.round)
         try:
-            backend_verify_batch(
+            # Per-seat statements (each voter signs its own high_qc_round),
+            # but still ONE fused job over the packed 72-byte records.
+            backend_verify_cert(
                 [
                     sha512_digest(round_le, buf[i * rec + 64 : i * rec + 72]).data
                     for i in range(len(seat_list))
                 ],
                 [keys[s].data for s in seat_list],
-                [buf[i * rec : i * rec + 64] for i in range(len(seat_list))],
+                buf,
+                rec,
+                key=CertificateCache.key_of(self),
             )
         except BackendUnavailable:
             raise  # infrastructure failure, NOT a byzantine signature

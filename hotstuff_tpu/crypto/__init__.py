@@ -318,6 +318,64 @@ def backend_verify_batch(msgs, pubs, sigs) -> None:
     _memo_put(memo, key, True)
 
 
+# -- fused aggregate-certificate dispatch ------------------------------------
+#
+# A wire-v2 certificate is a seat bitmap plus one packed signature buffer;
+# the fused path hands the crypto plane ONE job per cert (buffer + stride,
+# never 2f+1 sliced Signature objects) and verifies it as a single RLC MSM
+# with deterministic coefficients (cpu_batch.cert_rlc_coefficients).
+# ``HOTSTUFF_AGG_QC=0`` is the kill-switch: certs then explode into the
+# pre-aggregate per-signature batch path, byte-identical behavior.
+
+
+def agg_qc_enabled() -> bool:
+    """True unless ``HOTSTUFF_AGG_QC=0`` disables fused cert verification
+    (read per call so tests and operators can flip it live)."""
+    return os.environ.get("HOTSTUFF_AGG_QC", "1") != "0"
+
+
+def _explode_cert(msgs, pubs, sig_buf, stride, n):
+    """Per-signature (msgs, pubs, sigs) lists for a packed cert — the
+    fallback shape for backends/paths without a fused entry point."""
+    sig_buf = bytes(sig_buf)
+    if isinstance(msgs, (bytes, bytearray, memoryview)):
+        msg_list = [bytes(msgs)] * n
+    else:
+        msg_list = [bytes(m) for m in msgs]
+    pub_list = [bytes(p) for p in pubs]
+    sig_list = [sig_buf[stride * i : stride * i + 64] for i in range(n)]
+    return msg_list, pub_list, sig_list
+
+
+def backend_verify_cert(msgs, pubs, sig_buf, stride: int = 64, key=None) -> None:
+    """Dispatch one fused certificate verification to the active backend.
+
+    ``pubs``: the cert's n public keys (bytes each); ``sig_buf``: its
+    packed signature buffer at ``stride`` bytes per record (signature in
+    the first 64); ``msgs``: one shared statement (QC) or a per-seat list
+    (TC). ``key`` is an optional canonical cert identity the super-batching
+    layer uses to dedup concurrent verifies of the same cert. Raises
+    CryptoError on an invalid cert.
+
+    Falls back to the exploded ``backend_verify_batch`` path when the
+    verdict memo is active (sim plane: exploded triples keep ONE unified
+    memo keyspace with the structured paths), when ``HOTSTUFF_AGG_QC=0``,
+    or when the active backend has no fused entry point.
+    """
+    n = len(pubs)
+    if n == 0:
+        return
+    if _VERIFY_MEMO is not None or not agg_qc_enabled():
+        m, p, s = _explode_cert(msgs, pubs, sig_buf, stride, n)
+        return backend_verify_batch(m, p, s)
+    backend = get_backend()
+    fused = getattr(backend, "verify_cert", None)
+    if fused is None:
+        m, p, s = _explode_cert(msgs, pubs, sig_buf, stride, n)
+        return backend.verify_batch(m, p, s)
+    return fused(msgs, pubs, sig_buf, stride, key=key)
+
+
 class PublicKey:
     """Compressed Edwards point, 32 bytes; base64 serde; ordered (for
     round-robin leader election over sorted keys, reference
@@ -634,6 +692,27 @@ class CpuBackend:
                     ) from None
                 if not ed25519_ref.verify(pub, msg, sig, strict=False):
                     raise CryptoError("invalid signature in batch") from None
+
+    def verify_cert(self, msgs, pubs, sig_buf, stride: int = 64, key=None) -> None:
+        """Fused aggregate-certificate verification: one RLC MSM over the
+        cert's packed signature buffer (``native_ed25519.verify_cert_native``).
+        Acceptance set identical to ``verify_batch`` over the exploded
+        slices — the deterministic-coefficient RLC rejects any corrupted
+        slice with the same cofactored semantics. Falls back to the
+        exploded batch path when the native engine is unavailable."""
+        n = len(pubs)
+        from hotstuff_tpu import telemetry
+
+        telemetry.counter("crypto.dispatch.cpu_cert").inc()
+        telemetry.counter("crypto.dispatch.cpu_cert_sigs").inc(n)
+        if self._rlc is not None:
+            from .native_ed25519 import verify_cert_native
+
+            if not verify_cert_native(msgs, pubs, sig_buf, stride):
+                raise CryptoError("invalid signature in certificate")
+            return
+        m, p, s = _explode_cert(msgs, pubs, sig_buf, stride, n)
+        self.verify_batch(m, p, s)
 
 
 _BACKEND = None

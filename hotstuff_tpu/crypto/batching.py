@@ -28,18 +28,30 @@ import time
 
 from hotstuff_tpu import telemetry
 
-from . import BackendUnavailable, CryptoError, get_backend, set_backend
+from . import (
+    BackendUnavailable,
+    CryptoError,
+    _explode_cert,
+    get_backend,
+    set_backend,
+)
 
 
 class _Request:
-    __slots__ = ("msgs", "pubs", "sigs", "done", "error")
+    __slots__ = ("msgs", "pubs", "sigs", "cert", "done", "error")
 
     def __init__(self, msgs, pubs, sigs) -> None:
         self.msgs = msgs
         self.pubs = pubs
         self.sigs = sigs
+        # Fused-cert requests carry (msgs, pubs, sig_buf, stride, key)
+        # here and leave the triple lists empty.
+        self.cert = None
         self.done = threading.Event()
         self.error: CryptoError | None = None
+
+    def nsigs(self) -> int:
+        return len(self.cert[1]) if self.cert is not None else len(self.msgs)
 
 
 class BatchingBackend:
@@ -66,9 +78,17 @@ class BatchingBackend:
         self.fused_requests = 0
         self.inner_calls = 0
         self.deduped_sigs = 0
+        self.cert_requests = 0
+        self.cert_deduped_sigs = 0
         self._m_requests = telemetry.counter("crypto.superbatch.requests")
         self._m_flushes = telemetry.counter("crypto.superbatch.flushes")
         self._m_deduped = telemetry.counter("crypto.superbatch.deduped_sigs")
+        self._m_cert_requests = telemetry.counter(
+            "crypto.superbatch.cert_requests"
+        )
+        self._m_cert_deduped = telemetry.counter(
+            "crypto.superbatch.cert_deduped_sigs"
+        )
         self._h_occupancy = telemetry.histogram(
             "crypto.superbatch.occupancy", telemetry.COUNT_BUCKETS
         )
@@ -85,7 +105,27 @@ class BatchingBackend:
     def verify_batch(self, msgs, pubs, sigs) -> None:
         if not len(msgs) == len(pubs) == len(sigs):
             raise CryptoError("batch length mismatch")
-        req = _Request(list(msgs), list(pubs), list(sigs))
+        self._submit(_Request(list(msgs), list(pubs), list(sigs)))
+
+    def verify_cert(self, msgs, pubs, sig_buf, stride: int = 64, key=None) -> None:
+        """Fused certificate verification through the same back-pressure
+        pool: concurrent verifies of the SAME cert (an in-process committee
+        fans one proposal's QC to all N validators) dedup by cert identity
+        to one inner MSM. ``key`` is the caller's canonical cert identity;
+        without one, the full verify statement is the key."""
+        sig_buf = bytes(sig_buf)
+        if key is None:
+            mk = (
+                bytes(msgs)
+                if isinstance(msgs, (bytes, bytearray, memoryview))
+                else tuple(bytes(m) for m in msgs)
+            )
+            key = (mk, tuple(bytes(p) for p in pubs), sig_buf, stride)
+        req = _Request((), (), ())
+        req.cert = (msgs, pubs, sig_buf, stride, key)
+        self._submit(req)
+
+    def _submit(self, req: _Request) -> None:
         with self._cv:
             self._pending.append(req)
             if self._thread is None:
@@ -123,13 +163,20 @@ class BatchingBackend:
                 pass
 
     def _flush(self, batch: list[_Request]) -> None:
+        certs = [r for r in batch if r.cert is not None]
+        triples = [r for r in batch if r.cert is None]
         self.fused_requests += len(batch)
-        self._m_requests.inc(len(batch))
+        self._m_requests.inc(len(triples))
+        if certs:
+            self.cert_requests += len(certs)
+            self._m_cert_requests.inc(len(certs))
         self._m_flushes.inc()
         self._h_occupancy.observe(len(batch))
         t0 = time.perf_counter()
         fused_ok = False
         try:
+            if certs:
+                self._flush_certs(certs)
             # Dedup identical (msg, pub, sig) triples across the fused
             # requests: verifying the DISTINCT set decides the multiset —
             # every duplicate is the same mathematical statement, and the
@@ -143,9 +190,11 @@ class BatchingBackend:
             # one, not N. If the deduped batch fails, each request is
             # still re-verified separately below (exact per-request
             # verdicts, nothing poisoned).
+            if not triples:
+                return  # finally still prices the flush
             seen = set()
             msgs, pubs, sigs = [], [], []
-            for r in batch:
+            for r in triples:
                 for m, p, s in zip(r.msgs, r.pubs, r.sigs):
                     key = (m, p, s)
                     if key in seen:
@@ -154,7 +203,7 @@ class BatchingBackend:
                     msgs.append(m)
                     pubs.append(p)
                     sigs.append(s)
-            removed = sum(len(r.msgs) for r in batch) - len(msgs)
+            removed = sum(len(r.msgs) for r in triples) - len(msgs)
             self.deduped_sigs += removed
             self._m_deduped.inc(removed)
             try:
@@ -170,7 +219,7 @@ class BatchingBackend:
                 # Isolate: one bad request must not fail its neighbors —
                 # and a NON-crypto failure (JAX RuntimeError, device/tunnel
                 # death) must fail loudly, not wedge every waiter.
-                for r in batch:
+                for r in triples:
                     try:
                         self.inner_calls += 1
                         self.inner.verify_batch(r.msgs, r.pubs, r.sigs)
@@ -197,12 +246,55 @@ class BatchingBackend:
                     r.done.set()
             elapsed_ms = (time.perf_counter() - t0) * 1e3
             self._h_flush_ms.observe(elapsed_ms)
-            n_sigs = sum(len(r.msgs) for r in batch)
+            n_sigs = sum(r.nsigs() for r in batch)
             if n_sigs:
                 # Amortized per-signature cost of the flush — directly
                 # comparable with the bench corpus's µs/sig rows (the
                 # 0.022-0.026 ms regime the fine buckets resolve).
                 self._h_per_sig_ms.observe(elapsed_ms / n_sigs)
+
+    def _flush_certs(self, certs: list[_Request]) -> None:
+        """Verify the DISTINCT certs of a fused window, one inner MSM each.
+
+        Certs dedup by identity, not per-triple: a cert's verify statement
+        is atomic (one bitmap + one buffer), and concurrent requests for
+        the same cert are the same statement — priced at one. Each request
+        gets its own verdict object; a bad cert fails only its own waiters.
+        """
+        groups: dict = {}
+        for r in certs:
+            groups.setdefault(r.cert[4], []).append(r)
+        removed = sum(
+            len(rs[0].cert[1]) * (len(rs) - 1) for rs in groups.values()
+        )
+        self.cert_deduped_sigs += removed
+        self._m_cert_deduped.inc(removed)
+        fused = getattr(self.inner, "verify_cert", None)
+        for rs in groups.values():
+            msgs, pubs, sig_buf, stride, _key = rs[0].cert
+            err_text = None
+            unavailable = None
+            try:
+                self.inner_calls += 1
+                if fused is not None:
+                    fused(msgs, pubs, sig_buf, stride)
+                else:
+                    m, p, s = _explode_cert(
+                        msgs, pubs, sig_buf, stride, len(pubs)
+                    )
+                    self.inner.verify_batch(m, p, s)
+            except CryptoError as e:
+                err_text = str(e)
+            except Exception as e:
+                unavailable = f"verification backend failure: {e!r}"
+            for r in rs:
+                # Fresh exception per waiter: one instance raised from
+                # several threads would race on __traceback__.
+                if err_text is not None:
+                    r.error = CryptoError(err_text)
+                elif unavailable is not None:
+                    r.error = BackendUnavailable(unavailable)
+                r.done.set()
 
 
 def enable_superbatching(

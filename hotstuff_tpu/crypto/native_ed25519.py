@@ -83,6 +83,16 @@ def _load():
             ctypes.c_char_p,
             ctypes.c_char_p,
         ]
+        lib.hs_ed25519_cert_challenges.restype = ctypes.c_int
+        lib.hs_ed25519_cert_challenges.argtypes = [
+            ctypes.c_char_p,  # shared message
+            ctypes.c_uint64,  # message length
+            ctypes.c_char_p,  # pubs (n*32)
+            ctypes.c_char_p,  # packed signature buffer (n*stride)
+            ctypes.c_uint64,  # stride (>= 64)
+            ctypes.c_uint64,  # n
+            ctypes.c_char_p,  # out (n*64 digests)
+        ]
         lib.hs_ed25519_stats.restype = ctypes.c_int
         lib.hs_ed25519_stats.argtypes = [
             ctypes.POINTER(ctypes.c_uint64),
@@ -104,6 +114,7 @@ def _load():
             [
                 "hs_ed25519_msm_is_identity", "hs_ed25519_msm_signed",
                 "hs_ed25519_decompress_check", "hs_ed25519_scalarmult_base",
+                "hs_ed25519_cert_challenges",
             ],
         )
     return _lib
@@ -111,7 +122,8 @@ def _load():
 
 # hs_ed25519_stats field order (new fields append; indices never move).
 ED25519_STATS_FIELDS = (
-    "msm_calls", "msm_points", "scalarmult_calls", "decompress_calls"
+    "msm_calls", "msm_points", "scalarmult_calls", "decompress_calls",
+    "cert_challenge_calls", "cert_challenge_sigs",
 )
 
 
@@ -237,6 +249,104 @@ def verify_batch_native(msgs, pubs, sigs, rng=None) -> bool:
     scalars += ((-b_coeff) % L).to_bytes(32, "little")
 
     rc = _load().hs_ed25519_msm_signed(
+        bytes(encodings),
+        bytes(pre_xy),
+        bytes(flags),
+        bytes(scalars),
+        m,
+        _signed_window(m),
+        1,
+    )
+    if rc < 0:
+        raise ValueError("native ed25519 engine rejected arguments")
+    return rc == 1
+
+
+def verify_cert_native(msgs, pubs, sig_buf, stride: int = 64) -> bool:
+    """Fused aggregate-certificate verification on the native engine.
+
+    ``pubs``: n public keys; ``sig_buf``: the cert's packed signature
+    buffer at ``stride`` bytes per record (signature in the first 64);
+    ``msgs``: one shared bytes statement (QC) or a per-seat list (TC).
+    One RLC equation over the whole cert — the n challenge hashes run
+    behind a single ctypes crossing when the message is shared, the RLC
+    coefficients are the deterministic Fiat–Shamir stream from
+    ``cpu_batch.cert_rlc_coefficients``, and the whole cert folds into
+    one signed-digit MSM (m = 2n+1 lanes). Same canonicality rejections
+    as ``verify_batch_native``.
+    """
+    n = len(pubs)
+    if n == 0:
+        return True
+    sig_buf = bytes(sig_buf)
+    if len(sig_buf) < stride * (n - 1) + 64:
+        return False
+    from .cpu_batch import cert_rlc_coefficients
+
+    zs = cert_rlc_coefficients(msgs, pubs, sig_buf, stride, n)
+    lib = _load()
+
+    pubs_buf = b"".join(bytes(p) for p in pubs)
+    if len(pubs_buf) != 32 * n:
+        return False
+    shared = isinstance(msgs, (bytes, bytearray, memoryview))
+    if shared:
+        msg = bytes(msgs)
+        digests = ctypes.create_string_buffer(64 * n)
+        rc = lib.hs_ed25519_cert_challenges(
+            msg, len(msg), pubs_buf, sig_buf, stride, n, digests
+        )
+        if rc != 1:
+            raise ValueError("native cert-challenge engine rejected arguments")
+        digests = digests.raw
+    else:
+        digests = b"".join(
+            hashlib.sha512(
+                sig_buf[stride * i : stride * i + 32]
+                + pubs_buf[32 * i : 32 * i + 32]
+                + bytes(msgs[i])
+            ).digest()
+            for i in range(n)
+        )
+
+    m = 2 * n + 1
+    encodings = bytearray()
+    pre_xy = bytearray()
+    flags = bytearray()
+    scalars = bytearray()
+    zero64 = bytes(64)
+    b_coeff = 0
+    for i in range(n):
+        base = stride * i
+        r_enc = sig_buf[base : base + 32]
+        s = int.from_bytes(sig_buf[base + 32 : base + 64], "little")
+        if s >= L:  # non-canonical s: reject (RFC 8032 / dalek)
+            return False
+        pub = pubs_buf[32 * i : 32 * i + 32]
+        if (int.from_bytes(pub, "little") & _HALF_MASK) >= P:
+            return False
+        if (int.from_bytes(r_enc, "little") & _HALF_MASK) >= P:
+            return False
+        z = zs[i]
+        h = int.from_bytes(digests[64 * i : 64 * i + 64], "little") % L
+        b_coeff = (b_coeff + z * s) % L
+        encodings += r_enc
+        pre_xy += zero64
+        flags.append(0)
+        scalars += z.to_bytes(32, "little")
+        xy = _cached_xy(pub)
+        if xy is None:
+            return False  # invalid public key (same verdict as in-MSM)
+        encodings += pub
+        pre_xy += xy
+        flags.append(1)
+        scalars += (z * h % L).to_bytes(32, "little")
+    encodings += _B_ENC
+    pre_xy += _cached_xy(_B_ENC)
+    flags.append(1)
+    scalars += ((-b_coeff) % L).to_bytes(32, "little")
+
+    rc = lib.hs_ed25519_msm_signed(
         bytes(encodings),
         bytes(pre_xy),
         bytes(flags),

@@ -80,11 +80,21 @@ _RING_LID_U64 = struct.Struct("<BQQ")  # op 1 (set_round) / 2 (consumed)
 _RING_SEND_HDR = struct.Struct("<BHBI")  # op 3: port, host_len, payload_len
 _RING_BCAST_HDR = struct.Struct("<BHI")  # op 4: addrs_len, payload_len
 _RING_VF_HDR = struct.Struct("<BQI")  # op 5: listener_id, payload_len
+_RING_REPLY_HDR = struct.Struct("<BQI")  # op 6: conn_id, payload_len
+_RING_RSEND_HDR = struct.Struct("<BHBQI")  # op 7: port, host_len, msg_id, plen
 _RING_OP_SET_ROUND = 1
 _RING_OP_CONSUMED = 2
 _RING_OP_SEND = 3
 _RING_OP_BROADCAST = 4
 _RING_OP_VOTE_FILTER = 5
+_RING_OP_REPLY = 6
+_RING_OP_SEND_RELIABLE = 7
+# Payloads above this ride the direct ctypes call even when the ring is
+# on: the ring buys one crossing per loop iteration, but every ring byte
+# is copied twice more (Python append + C++ parse), so for bulk frames
+# (dataplane batches, ~387 KB) the copies dominate the crossing saved.
+# ACKs and votes/proposals sit far below it.
+_RING_PAYLOAD_MAX = 64 * 1024
 
 # Fixed Vote wire frame length (consensus/messages.py layout) — the unit
 # EV_VOTE_BATCH payloads are sliced into.
@@ -608,17 +618,36 @@ class NativeTransport:
             # reliable ACK futures stay pending until the caller cancels.
             self._park_send(host, port, data, reliable, msg_id)
             return
-        if not reliable and msg_id == 0:
-            # Best-effort sends ride the command ring; reliable sends
-            # stay direct (their ACK-future bookkeeping on the Python
-            # side is already per-message, and proposals are one frame
-            # per round — not the crossing storm the ring exists for).
+        if len(data) <= _RING_PAYLOAD_MAX:
+            # Small frames ride the command ring — best-effort AND
+            # reliable. Reliable sends were originally kept direct
+            # ("proposals are one frame per round"), but the dataplane's
+            # batch dissemination is reliable at rate: at large-frame
+            # load the per-send crossing + loop wake was the measured
+            # gap vs asyncio (benchmark/netplane_frames.py). The ACK
+            # future is registered by the caller before this returns,
+            # and ring flushes run before any subsequent drain of the
+            # ACK event, so pairing is unchanged. Bulk frames above
+            # _RING_PAYLOAD_MAX keep the direct call (copy-dominated).
             rhost = resolved.encode()
-            if self._ring_push(
-                _RING_SEND_HDR.pack(_RING_OP_SEND, port, len(rhost), len(data))
-                + rhost
-                + data
-            ):
+            if not reliable and msg_id == 0:
+                rec = (
+                    _RING_SEND_HDR.pack(
+                        _RING_OP_SEND, port, len(rhost), len(data)
+                    )
+                    + rhost
+                    + data
+                )
+            else:
+                rec = (
+                    _RING_RSEND_HDR.pack(
+                        _RING_OP_SEND_RELIABLE, port, len(rhost),
+                        msg_id, len(data),
+                    )
+                    + rhost
+                    + data
+                )
+            if self._ring_push(rec):
                 return
         self._lib.hs_net_send(
             self._ctx, resolved.encode(), ctypes.c_uint16(port),
@@ -656,6 +685,12 @@ class NativeTransport:
         self._lib.hs_net_cancel(self._ctx, ctypes.c_uint64(msg_id))
 
     def reply(self, conn_id: int, data: bytes) -> None:
+        # ACKs are tiny and per-frame — the highest-frequency crossing on
+        # a busy receiver; ride the ring (one flush per loop iteration).
+        if len(data) <= _RING_PAYLOAD_MAX and self._ring_push(
+            _RING_REPLY_HDR.pack(_RING_OP_REPLY, conn_id, len(data)) + data
+        ):
+            return
         self._lib.hs_net_reply(
             self._ctx, ctypes.c_uint64(conn_id), data, len(data)
         )

@@ -61,7 +61,12 @@ constexpr size_t SIMPLE_QUEUE_CAP = 1000;   // frames; matches Python sender
 // epoll round, starving other connections and letting a flood blow past
 // the listener-pause back-pressure before the pause command is serviced.
 // Level-triggered epoll re-fires for the remainder.
-constexpr size_t READ_BATCH_CAP = 256 * 1024;
+// Sized above the dataplane's bulk batch frames (~387 KB): a budget
+// below one frame guarantees TWO epoll wakes per frame plus a partial-
+// frame memmove on every erase, which is where the native plane lost to
+// asyncio on large frames (ROADMAP 3a).
+constexpr size_t READ_BATCH_CAP = 512 * 1024;
+constexpr size_t READ_CHUNK = 64 * 1024;
 constexpr int RETRY_DELAY_MS = 200;
 constexpr int RETRY_CAP_MS = 60000;
 
@@ -830,18 +835,22 @@ class NetCore {
       // the connection before parsing would silently discard that
       // frame. Parse first, drop after.
       bool conn_gone = false;
-      char buf[64 * 1024];
       size_t got = 0;
       while (got < READ_BATCH_CAP) {
-        ssize_t r = read(c.fd, buf, sizeof buf);
+        // Read straight into inbuf's tail — staging through a stack
+        // buffer costs an extra pass over every received byte, which
+        // dominates at bulk-frame sizes.
+        size_t old = c.inbuf.size();
+        c.inbuf.resize(old + READ_CHUNK);
+        ssize_t r = read(c.fd, &c.inbuf[old], READ_CHUNK);
         if (r > 0) {
-          c.inbuf.append(buf, size_t(r));
+          c.inbuf.resize(old + size_t(r));
           got += size_t(r);
           bytes_rx_ += uint64_t(r);
-        } else if (r == 0 || (r < 0 && errno != EAGAIN && errno != EWOULDBLOCK)) {
-          conn_gone = true;
-          break;
         } else {
+          c.inbuf.resize(old);
+          if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK))
+            conn_gone = true;
           break;
         }
       }
@@ -1542,6 +1551,9 @@ void hs_net_faults(void* ctx, const char* spec, uint32_t spec_len) {
 //                         | addrs ("ip:port ip:port ...") | payload
 //   op=5 SET_VOTE_FILTER: u8 op | u64 listener_id | u32 payload_len
 //                         | n*32B author keys
+//   op=6 REPLY:           u8 op | u64 conn_id | u32 payload_len | payload
+//   op=7 SEND_RELIABLE:   u8 op | u16 port | u8 host_len | u64 msg_id
+//                         | u32 payload_len | host | payload
 // A malformed record ends the parse (the Python side is the only
 // producer; truncation can only mean a caller bug, and enqueueing a
 // half-parsed tail would be worse than dropping it). Returns the number
@@ -1600,6 +1612,27 @@ int64_t hs_net_cmds_flush(void* ctx, const uint8_t* buf, uint32_t len) {
       c.payload.assign(
           reinterpret_cast<const char*>(buf + off + 13), plen);
       off += 13 + plen;
+    } else if (op == 6 && off + 13 <= len) {
+      uint32_t plen = rd_u32(off + 9);
+      if (off + 13 + uint64_t(plen) > len) break;
+      c.type = CMD_REPLY;
+      c.id = rd_u64(off + 1);
+      c.payload.assign(
+          reinterpret_cast<const char*>(buf + off + 13), plen);
+      off += 13 + plen;
+    } else if (op == 7 && off + 16 <= len) {
+      uint16_t port = rd_u16(off + 1);
+      uint8_t hlen = buf[off + 3];
+      uint64_t msg_id = rd_u64(off + 4);
+      uint32_t plen = rd_u32(off + 12);
+      if (off + 16 + hlen + uint64_t(plen) > len) break;
+      c.type = CMD_SEND_RELIABLE;
+      c.host.assign(reinterpret_cast<const char*>(buf + off + 16), hlen);
+      c.port = port;
+      c.id = msg_id;
+      c.payload.assign(
+          reinterpret_cast<const char*>(buf + off + 16 + hlen), plen);
+      off += 16 + hlen + plen;
     } else {
       break;  // unknown op or truncated record: stop
     }

@@ -1,3 +1,20 @@
-from .mesh import make_mesh, msm_sharded, verify_batch_device_sharded
+"""Parallel runtimes: device-mesh sharded crypto (``mesh``) and the
+process-sharded committee engine groups (``engine_groups``).
 
-__all__ = ["make_mesh", "msm_sharded", "verify_batch_device_sharded"]
+``mesh`` pulls in jax at import; the engine-group runtime is pure
+stdlib (multiprocessing + shared memory) and worker processes must not
+pay a jax import to boot, so the mesh exports resolve lazily (PEP 562).
+"""
+
+_MESH_EXPORTS = ("make_mesh", "msm_sharded", "verify_batch_device_sharded")
+
+
+def __getattr__(name):
+    if name in _MESH_EXPORTS:
+        from . import mesh
+
+        return getattr(mesh, name)
+    raise AttributeError(name)
+
+
+__all__ = [*_MESH_EXPORTS, "engine_groups"]

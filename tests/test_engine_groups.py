@@ -1,0 +1,142 @@
+"""Process-sharded engine groups: shared-memory SPSC ring protocol
+(record framing, wrap markers, trailing-sliver skip, pricing counters,
+cross-process visibility) and an end-to-end grouped committee run with
+merged telemetry.
+"""
+
+import multiprocessing
+import struct
+
+import pytest
+
+from hotstuff_tpu.parallel.engine_groups import (
+    OP_COMMIT,
+    OP_READY,
+    OP_STOP,
+    ShmRing,
+    groups_from_env,
+    run_grouped_committee,
+)
+
+_REC = struct.Struct("<BI")
+
+
+@pytest.fixture
+def ring():
+    r = ShmRing(create=True, capacity=1 << 10)
+    yield r
+    r.close()
+
+
+def test_ring_roundtrip_preserves_order_and_payloads(ring):
+    records = [(OP_READY, b""), (OP_COMMIT, b"x" * 17), (7, bytes(range(64)))]
+    for op, payload in records:
+        assert ring.try_push(op, payload)
+    assert ring.pop_all() == records
+    assert ring.pop_all() == []  # drained
+
+
+def test_ring_wraps_and_prices_the_wrap(ring):
+    """Fill past the arena edge repeatedly: every record survives the
+    wrap markers and sliver skips, and the producer prices each wrap."""
+    payload = bytes(100)
+    pushed = popped = 0
+    for _ in range(64):  # 64 * ~105B through a 1 KiB arena: many wraps
+        assert ring.try_push(OP_COMMIT, payload)
+        pushed += 1
+        for op, got in ring.pop_all():
+            assert op == OP_COMMIT and got == payload
+            popped += 1
+    assert popped == pushed
+    assert ring.wraps >= 5
+    c = ring.counters()
+    assert c["pushes"] == pushed and c["pops"] == popped
+    assert c["push_bytes"] == pushed * (_REC.size + len(payload))
+
+
+def test_ring_backpressure_full_then_drains(ring):
+    """try_push returns False at capacity (records may not be dropped or
+    overwritten), and space freed by the consumer is reusable."""
+    payload = bytes(200)
+    pushed = 0
+    while ring.try_push(OP_COMMIT, payload):
+        pushed += 1
+    assert 0 < pushed < 6  # 1 KiB arena holds at most 4 such records
+    assert not ring.try_push(OP_COMMIT, payload)
+    assert len(ring.pop_all()) == pushed
+    assert ring.try_push(OP_COMMIT, payload)  # freed space reusable
+
+
+def test_ring_rejects_record_larger_than_arena(ring):
+    with pytest.raises(ValueError):
+        ring.try_push(OP_COMMIT, bytes(1 << 10))
+
+
+def _producer(name, count):
+    r = ShmRing(name=name)
+    try:
+        for i in range(count):
+            r.push(OP_COMMIT, struct.pack("<I", i))
+    finally:
+        r.close()
+
+
+def test_ring_cross_process_visibility():
+    """The actual deployment shape: producer in a forked child, consumer
+    in the parent, records in order with no loss."""
+    ring = ShmRing(create=True, capacity=1 << 12)
+    try:
+        ctx = multiprocessing.get_context("fork")
+        p = ctx.Process(target=_producer, args=(ring.name, 500))
+        p.start()
+        got = []
+        while len(got) < 500:
+            got.extend(ring.pop_all())
+            assert p.exitcode in (None, 0)
+        p.join(timeout=30)
+        assert p.exitcode == 0
+        assert [struct.unpack("<I", pl)[0] for _, pl in got] == list(range(500))
+    finally:
+        ring.close()
+
+
+def test_groups_from_env(monkeypatch):
+    monkeypatch.delenv("HOTSTUFF_ENGINE_GROUPS", raising=False)
+    assert groups_from_env() == 0  # kill-switch default: single-process
+    monkeypatch.setenv("HOTSTUFF_ENGINE_GROUPS", "4")
+    assert groups_from_env() == 4
+    monkeypatch.setenv("HOTSTUFF_ENGINE_GROUPS", "junk")
+    assert groups_from_env() == 0
+    monkeypatch.setenv("HOTSTUFF_ENGINE_GROUPS", "-2")
+    assert groups_from_env() == 0
+
+
+def test_engine_groups_import_is_jax_free():
+    """Workers must not pay a jax import to boot: importing the runtime
+    through the package must not pull in jax (PEP 562 lazy mesh exports)."""
+    import subprocess
+    import sys
+
+    code = (
+        "import sys\n"
+        "import hotstuff_tpu.parallel.engine_groups\n"
+        "sys.exit(1 if 'jax' in sys.modules else 0)\n"
+    )
+    assert subprocess.run([sys.executable, "-c", code]).returncode == 0
+
+
+def test_grouped_committee_commits_and_merges_telemetry():
+    """End to end: n=4 over 2 worker processes commits rounds; the parent
+    sees per-node commit sequence numbers and a merged counter registry
+    including each group's ring pricing."""
+    per_round, merged = run_grouped_committee(
+        n=4, rounds_target=3, n_groups=2, base_port=19310
+    )
+    assert per_round > 0
+    counters = merged["counters"]
+    assert counters  # workers enabled telemetry before building engines
+    assert any(k.startswith("consensus.") for k in counters)
+    rings = merged["rings"]
+    assert rings["group0"]["pushes"] >= 1  # ready + commits + telemetry
+    assert rings["group1"]["pushes"] >= 1
+    assert rings["group0.parent"]["commands"]["pushes"] >= 1  # OP_STOP

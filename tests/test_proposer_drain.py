@@ -1,0 +1,104 @@
+"""Regression pin for the proposer's greedy digest drain.
+
+On a CPU-saturated loop the proposer task is scheduled far less often
+than digests arrive; the one-digest-per-turn behavior this pins against
+let the mempool queue backlog while proposals went out nearly empty
+(ordering starving behind ingest inside the event loop). The greedy
+drain takes everything ready in one wake, so
+``consensus.proposer.digest_queue_depth`` stays bounded under
+saturation and each proposal carries the backlog.
+"""
+
+import asyncio
+import random
+
+import pytest
+
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.consensus import Authority, Committee
+from hotstuff_tpu.consensus.messages import QC
+from hotstuff_tpu.consensus.proposer import Cleanup, Make, Proposer
+from hotstuff_tpu.crypto import Digest, SignatureService, generate_keypair
+
+from .common import async_test
+
+
+@pytest.fixture(autouse=True)
+def _telemetry():
+    telemetry.reset_for_tests()
+    telemetry.enable()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _solo_proposer():
+    """A one-authority committee: the proposer reaches ack quorum from
+    its own stake, so _make_block completes without any network."""
+    pk, sk = generate_keypair(seed=b"p" * 32)
+    committee = Committee(
+        authorities={pk: Authority(stake=1, address=("127.0.0.1", 0))}
+    )
+    rx_mempool: asyncio.Queue = asyncio.Queue()
+    rx_message: asyncio.Queue = asyncio.Queue()
+    tx_loopback: asyncio.Queue = asyncio.Queue()
+    task = Proposer.spawn(
+        pk, committee, SignatureService(sk), rx_mempool, rx_message, tx_loopback
+    )
+    return task, rx_mempool, rx_message, tx_loopback
+
+
+@async_test(timeout=60)
+async def test_digest_queue_depth_bounded_under_saturation():
+    """Dump a large digest burst, yield only a handful of event-loop
+    turns (a saturated loop's scheduling budget), then propose: the
+    proposal must carry the burst and the queue-depth gauge must be ~0.
+    One-digest-per-turn would leave nearly the whole burst queued."""
+    rng = random.Random(301)
+    task, rx_mempool, rx_message, tx_loopback = _solo_proposer()
+    try:
+        burst, rounds = 200, 5
+        total_carried = 0
+        for r in range(1, rounds + 1):
+            digests = [Digest(rng.randbytes(32)) for _ in range(burst)]
+            for d in digests:
+                rx_mempool.put_nowait(d)
+            # A saturated loop grants the proposer few turns between
+            # bursts — the greedy drain needs exactly one.
+            for _ in range(3):
+                await asyncio.sleep(0)
+            await rx_message.put(Make(round=r, qc=QC.genesis(), tc=None))
+            _tag, block = await asyncio.wait_for(tx_loopback.get(), timeout=30)
+            total_carried += len(block.payload)
+
+            depth = telemetry.gauge(
+                "consensus.proposer.digest_queue_depth"
+            ).value()
+            drained = telemetry.gauge(
+                "consensus.proposer.payload_drained"
+            ).value()
+            assert depth is not None and depth <= 8, (r, depth)
+            assert drained >= burst - 8, (r, drained)
+            await rx_message.put(Cleanup(digests=digests))
+        assert total_carried >= rounds * burst - 8
+    finally:
+        task.cancel()
+
+
+@async_test(timeout=60)
+async def test_cleanup_discards_before_next_proposal():
+    """Digests cleaned up between proposals must not reappear in the
+    next payload (the greedy drain must not resurrect them)."""
+    rng = random.Random(302)
+    task, rx_mempool, rx_message, tx_loopback = _solo_proposer()
+    try:
+        digests = [Digest(rng.randbytes(32)) for _ in range(32)]
+        for d in digests:
+            rx_mempool.put_nowait(d)
+        for _ in range(3):
+            await asyncio.sleep(0)
+        await rx_message.put(Cleanup(digests=digests[:16]))
+        await rx_message.put(Make(round=1, qc=QC.genesis(), tc=None))
+        _tag, block = await asyncio.wait_for(tx_loopback.get(), timeout=30)
+        assert set(block.payload) == set(digests[16:])
+    finally:
+        task.cancel()

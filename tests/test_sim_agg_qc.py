@@ -1,0 +1,149 @@
+"""Simulant model-check of the aggregate-QC verify rule.
+
+Before the fused one-MSM certificate check is trusted on the real
+planes, the deterministic simulation plane pins its acceptance set to
+the per-signature oracle: over an exhaustive corruption model (every
+seat, both signature halves, and individually-VALID signatures spliced
+in from the wrong statement), the fused check must reject exactly the
+certs the per-signature rule rejects — a cert that any seat's signature
+fails must be caught. Under the sim plane's process-wide verdict memo,
+fused dispatch falls back to exploded per-signature triples so the memo
+keyspace stays unified across the structured and raw paths.
+"""
+
+import random
+
+import pytest
+
+from hotstuff_tpu import crypto
+from hotstuff_tpu.crypto import (
+    CpuBackend,
+    CryptoError,
+    backend_verify_cert,
+    set_backend,
+)
+from hotstuff_tpu.crypto import ed25519_ref as ref
+from hotstuff_tpu.crypto.cpu_batch import verify_cert_rlc
+from hotstuff_tpu.crypto.native_ed25519 import native_available
+
+
+@pytest.fixture(autouse=True)
+def _isolate(monkeypatch):
+    monkeypatch.delenv("HOTSTUFF_AGG_QC", raising=False)
+    crypto.enable_verify_memo(False)
+    yield
+    crypto.enable_verify_memo(False)
+    set_backend("cpu")
+
+
+def _cert(n, rng):
+    seeds = [rng.randbytes(32) for _ in range(n)]
+    pubs = [ref.secret_to_public(s) for s in seeds]
+    msg = rng.randbytes(32)
+    return msg, seeds, pubs, b"".join(ref.sign(s, msg) for s in seeds)
+
+
+def _oracle(msg, pubs, sig_buf):
+    """The per-signature rule the fused check must reproduce."""
+    return all(
+        ref.verify(pub, msg, sig_buf[i * 64 : (i + 1) * 64], strict=False)
+        for i, pub in enumerate(pubs)
+    )
+
+
+def _fused_verdicts(msg, pubs, buf):
+    """Every fused implementation's verdict on one cert."""
+    verdicts = {"rlc": verify_cert_rlc(msg, pubs, buf)}
+    if native_available():
+        from hotstuff_tpu.crypto.native_ed25519 import verify_cert_native
+
+        verdicts["native"] = verify_cert_native(msg, pubs, buf)
+    return verdicts
+
+
+def test_model_check_fused_rule_matches_per_signature_oracle():
+    """Exhaustive single-seat corruption model over a 4-seat cert: for
+    every mutation, every fused implementation agrees with the oracle —
+    in particular, a cert containing ONE invalid signature is caught no
+    matter which seat or which half of the signature is wrong."""
+    rng = random.Random(201)
+    msg, seeds, pubs, buf = _cert(4, rng)
+    pub_bytes = [p for p in pubs]
+
+    cases = [("valid", buf)]
+    for seat in range(4):
+        base = seat * 64
+        for tag, pos in (("R", base + 3), ("s", base + 40)):
+            b = bytearray(buf)
+            b[pos] ^= 0x01
+            cases.append((f"seat{seat}-{tag}", bytes(b)))
+        # Individually-VALID signature of the WRONG statement spliced in:
+        # passes no per-byte sanity check, only actual verification.
+        alien = ref.sign(seeds[seat], rng.randbytes(32))
+        b = bytearray(buf)
+        b[base : base + 64] = alien
+        cases.append((f"seat{seat}-alien", bytes(b)))
+        # A neighbor's valid signature under seat's key: valid bytes,
+        # wrong key binding.
+        if seat:
+            b = bytearray(buf)
+            b[base : base + 64] = buf[:64]
+            cases.append((f"seat{seat}-swapped", bytes(b)))
+
+    for tag, candidate in cases:
+        want = _oracle(msg, pub_bytes, candidate)
+        assert want == (tag == "valid"), tag  # the model is well-formed
+        for impl, got in _fused_verdicts(msg, pub_bytes, candidate).items():
+            assert got == want, (tag, impl)
+
+
+class CountingBackend(CpuBackend):
+    def __init__(self):
+        super().__init__()
+        self.batch_calls = 0
+        self.cert_calls = 0
+
+    def verify_batch(self, msgs, pubs, sigs):
+        self.batch_calls += 1
+        super().verify_batch(msgs, pubs, sigs)
+
+    def verify_cert(self, msgs, pubs, sig_buf, stride=64, key=None):
+        self.cert_calls += 1
+        super().verify_cert(msgs, pubs, sig_buf, stride, key=key)
+
+
+def test_memo_unifies_fused_and_structured_keyspaces():
+    """Under the sim plane's verdict memo, fused dispatch explodes into
+    per-signature triples: the SAME memo entries then serve the
+    structured batch path, so sim verdicts cannot diverge between a cert
+    arriving raw (v2) and materialized (v1)."""
+    rng = random.Random(202)
+    msg, _seeds, pubs, buf = _cert(3, rng)
+    backend = CountingBackend()
+    set_backend(backend)
+    crypto.enable_verify_memo(True)
+
+    backend_verify_cert(msg, pubs, buf, 64)
+    assert backend.cert_calls == 0  # memo active: no fused entry touched
+    first = backend.batch_calls
+    assert first >= 1
+    # Same statements through the structured path: all memo hits.
+    sigs = [buf[i * 64 : (i + 1) * 64] for i in range(3)]
+    crypto.backend_verify_batch([msg] * 3, pubs, sigs)
+    assert backend.batch_calls == first
+
+
+def test_byzantine_cert_rejected_on_every_arrival_under_memo():
+    """Failure verdicts are memoized but never flipped: a cert with one
+    bad signature raises on every re-arrival in a sim run."""
+    rng = random.Random(203)
+    msg, _seeds, pubs, buf = _cert(3, rng)
+    bad = bytearray(buf)
+    bad[64 + 10] ^= 0x01
+    bad = bytes(bad)
+    set_backend(CountingBackend())
+    crypto.enable_verify_memo(True)
+    for _ in range(3):
+        with pytest.raises(CryptoError):
+            backend_verify_cert(msg, pubs, bad, 64)
+    backend_verify_cert(msg, pubs, buf, 64)  # the honest cert still passes
