@@ -52,6 +52,11 @@ def _shed_total(logs_dir: str) -> int:
     return total
 
 
+#: per-point cap on per-batch lifeline rows kept in the artifact (the
+#: aggregate edge stats always cover every batch; only the raw rows trim).
+DTRACE_BATCH_CAP = 200
+
+
 def run_point(
     rate: int,
     *,
@@ -64,6 +69,7 @@ def run_point(
     batch_size: int,
     max_batch_delay: int,
     timeout: int,
+    dtrace: bool = False,
 ) -> dict:
     bench = LocalBench(
         nodes=nodes,
@@ -76,10 +82,12 @@ def run_point(
         max_batch_delay=max_batch_delay,
         work_dir=work_dir,
         workers=workers,
+        telemetry=dtrace,
     )
     parser = bench.run()
     e2e_tps, e2e_bps, dur = parser._end_to_end_throughput()
     c_tps, c_bps, _ = parser._consensus_throughput()
+    logs_dir = os.path.join(os.path.abspath(work_dir), "logs")
     row = {
         "rate": rate,
         "e2e_tps": round(e2e_tps),
@@ -88,9 +96,30 @@ def run_point(
         "consensus_tps": round(c_tps),
         "consensus_latency_ms": round(parser._consensus_latency() * 1e3),
         "duration_s": round(dur, 1),
-        "shed": _shed_total(os.path.join(os.path.abspath(work_dir), "logs")),
+        "shed": _shed_total(logs_dir),
         "rate_misses": parser.misses,
     }
+    if dtrace:
+        # Per-batch edge attribution assembled from this point's streams
+        # (joined to round traces and the clients' sampled submit lines).
+        from benchmark.dtrace_assemble import assemble
+
+        streams = sorted(
+            glob.glob(os.path.join(logs_dir, "telemetry-*.jsonl"))
+        )
+        try:
+            report = assemble(
+                streams,
+                client_paths=sorted(
+                    glob.glob(os.path.join(logs_dir, "client-*.log"))
+                ),
+            )
+            if len(report["per_batch"]) > DTRACE_BATCH_CAP:
+                report["per_batch"] = report["per_batch"][:DTRACE_BATCH_CAP]
+                report["per_batch_truncated"] = True
+            row["dtrace"] = report
+        except Exception as e:  # noqa: BLE001 — attribution is advisory
+            row["dtrace"] = {"error": str(e)}
     return row
 
 
@@ -130,6 +159,12 @@ def main() -> None:
     p.add_argument("--work-dir", default=".dataplane-bench")
     p.add_argument("--output", help="directory for the sweep artifact")
     p.add_argument(
+        "--dtrace", action="store_true",
+        help="stream telemetry from every node and attach the assembled "
+        "per-batch lifeline attribution (seven-edge) to each point; also "
+        "writes a dataplane-dtrace-*.json artifact under --output",
+    )
+    p.add_argument(
         "--gate", action="store_true",
         help="compare the peak against the committed baseline artifact",
     )
@@ -162,11 +197,20 @@ def main() -> None:
                 batch_size=args.batch_size,
                 max_batch_delay=args.max_batch_delay,
                 timeout=args.timeout,
+                dtrace=args.dtrace,
             )
         except (BenchError, ParseError) as e:
             row = {"rate": rate, "error": str(e)}
         rows.append(row)
-        print(json.dumps(row), flush=True)
+        # Per-point console line stays one line: the lifeline report (if
+        # any) is summarized to its cost-center ranking here and kept in
+        # full in the report/artifact.
+        preview = {k: v for k, v in row.items() if k != "dtrace"}
+        if isinstance(row.get("dtrace"), dict):
+            preview["dtrace_top"] = row["dtrace"].get(
+                "top_cost_centers", row["dtrace"].get("error")
+            )
+        print(json.dumps(preview), flush=True)
         # Fresh port block per point: TIME_WAIT sockets from the last
         # point must not collide with the next committee.
         port += 20 * args.nodes * (args.workers + 3)
@@ -217,7 +261,24 @@ def main() -> None:
         gate["ok"] = ok
         report["gate"] = gate
 
-    print(json.dumps(report, indent=2, sort_keys=True))
+    print(
+        json.dumps(
+            {
+                **report,
+                "rows": [
+                    {k: v for k, v in r.items() if k != "dtrace"}
+                    for r in report["rows"]
+                ],
+                "peak": (
+                    {k: v for k, v in peak.items() if k != "dtrace"}
+                    if peak
+                    else None
+                ),
+            },
+            indent=2,
+            sort_keys=True,
+        )
+    )
     if args.output:
         os.makedirs(args.output, exist_ok=True)
         path = os.path.join(
@@ -229,6 +290,26 @@ def main() -> None:
             json.dump(report, f, indent=2, sort_keys=True)
             f.write("\n")
         print(f"artifact written to {path}")
+        if args.dtrace and peak and isinstance(peak.get("dtrace"), dict):
+            # The lifeline attribution stands alone too: the per-batch
+            # edge breakdown at the sweep's peak point, the artifact the
+            # latency profile doc cites.
+            dpath = os.path.join(
+                args.output,
+                f"dataplane-dtrace-n{args.nodes}-w{args.workers}-"
+                f"{args.tx_size}B.json",
+            )
+            with open(dpath, "w") as f:
+                json.dump(
+                    {
+                        "config": report["config"],
+                        "rate": peak["rate"],
+                        "lifeline": peak["dtrace"],
+                    },
+                    f, indent=2, sort_keys=True,
+                )
+                f.write("\n")
+            print(f"lifeline artifact written to {dpath}")
     if args.gate:
         print(f"dataplane gate: {'GREEN' if ok else 'RED'}")
         if not ok:

@@ -36,11 +36,13 @@ from statistics import mean
 
 from hotstuff_tpu.telemetry import (
     ALERT_SCHEMA,
+    DTRACE_SCHEMA,
     META_SCHEMA,
     PROFILE_SCHEMA,
     SCHEMA as SNAPSHOT_SCHEMA,
     TRACE_SCHEMA,
     validate_alert_record,
+    validate_dtrace_record,
     validate_meta_record,
     validate_profile_record,
     validate_snapshot,
@@ -251,7 +253,8 @@ class StreamRecords:
     """One parsed telemetry stream, by record schema.
 
     ``snapshots`` are the ``hotstuff-telemetry-v1`` lines, ``traces`` the
-    interleaved ``hotstuff-trace-v1`` lines, ``profiles`` the
+    interleaved ``hotstuff-trace-v1`` lines, ``dtraces`` the
+    ``hotstuff-dtrace-v1`` batch-lifecycle lines, ``profiles`` the
     ``hotstuff-profile-v1`` sampling-profiler lines, ``meta`` the
     ``hotstuff-meta-v1`` stream self-descriptions (one per writer; a
     restart of the same node appends another), ``alerts`` any
@@ -262,11 +265,15 @@ class StreamRecords:
     anywhere but the last line still raises — mid-file corruption is a
     real bug, not crash fallout."""
 
-    __slots__ = ("snapshots", "traces", "profiles", "meta", "alerts", "skipped")
+    __slots__ = (
+        "snapshots", "traces", "dtraces", "profiles", "meta", "alerts",
+        "skipped",
+    )
 
     def __init__(self) -> None:
         self.snapshots: list[dict] = []
         self.traces: list[dict] = []
+        self.dtraces: list[dict] = []
         self.profiles: list[dict] = []
         self.meta: list[dict] = []
         self.alerts: list[dict] = []
@@ -299,6 +306,11 @@ def read_stream_records(path: str) -> StreamRecords:
             if problems:
                 raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
             records.traces.append(obj)
+        elif schema == DTRACE_SCHEMA:
+            problems = validate_dtrace_record(obj)
+            if problems:
+                raise ParseError(f"{path}:{lineno}: {'; '.join(problems)}")
+            records.dtraces.append(obj)
         elif schema == PROFILE_SCHEMA:
             problems = validate_profile_record(obj)
             if problems:
@@ -412,6 +424,7 @@ class StreamFollower:
         validator = {
             SNAPSHOT_SCHEMA: validate_snapshot,
             TRACE_SCHEMA: validate_trace_record,
+            DTRACE_SCHEMA: validate_dtrace_record,
             PROFILE_SCHEMA: validate_profile_record,
             META_SCHEMA: validate_meta_record,
             ALERT_SCHEMA: validate_alert_record,

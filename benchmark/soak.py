@@ -209,6 +209,33 @@ def run_soak(args) -> dict:
             ),
         }
 
+    # Per-batch lifeline attribution from the nodes' dtrace records
+    # (only present under --dtrace; absence is not an error). The soak
+    # keeps just the aggregate face — edge stats, cost centers, and the
+    # incomplete-lifeline census (a batch stuck mid-pipeline during
+    # chaos is exactly what this section is for).
+    dtrace_attr = None
+    if args.dtrace:
+        try:
+            from benchmark.dtrace_assemble import assemble
+
+            report = assemble(
+                sorted(glob.glob(os.path.join(logs_dir, "telemetry-*.jsonl")))
+            )
+            dtrace_attr = {
+                "batches": report["batches"],
+                "complete": report["complete"],
+                "incomplete_by_stage_reached": report[
+                    "incomplete_by_stage_reached"
+                ],
+                "total_ms": report["total_ms"],
+                "edges": report["edges"],
+                "top_cost_centers": report["top_cost_centers"],
+                "slowest_batches": report["slowest_batches"][:3],
+            }
+        except Exception as e:  # noqa: BLE001 — attribution is advisory
+            dtrace_attr = {"error": str(e)}
+
     # Function-level attribution from the nodes' profile records (only
     # present under --pyprof; absence is not an error).
     profile_attr = None
@@ -286,6 +313,7 @@ def run_soak(args) -> dict:
         "commit": commit_section,
         "alerts": alerts_section,
         "profile": profile_attr,
+        "dtrace": dtrace_attr,
         "parse_error": parse_error,
         "skipped_stream_lines": skipped,
         "summary": summary,
@@ -335,6 +363,11 @@ def main() -> None:
     p.add_argument(
         "--store-growth-mb-s", type=float, default=32.0,
         help="memory-growth SLO: max on-disk store growth (MiB/s)",
+    )
+    p.add_argument(
+        "--dtrace", action="store_true",
+        help="join the per-batch lifeline attribution (edge stats, cost "
+        "centers, stuck-batch census) into the verdict",
     )
     p.add_argument(
         "--pyprof", action="store_true",

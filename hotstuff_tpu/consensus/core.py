@@ -281,6 +281,18 @@ class Core:
                     # Telemetry mirror of the "Committed B -> d" contract
                     # (no-op unless telemetry is enabled).
                     telemetry.record_commit(d.data)
+                if telemetry.dtrace_enabled():
+                    # Lifeline ordering-edge close: every node marks the
+                    # commit per payload digest (the assembler keeps the
+                    # earliest — the round-trace first-commit semantics).
+                    name_label = repr(self.name)
+                    for d in blk.payload:
+                        telemetry.dtrace_event(
+                            name_label,
+                            telemetry.intern_label(d.data),
+                            "committed",
+                            detail=f"r{blk.round}",
+                        )
                 if self.benchmark:
                     for d in blk.payload:
                         # NOTE: benchmark measurement interface (reference

@@ -116,6 +116,19 @@ class Proposer:
                 # Telemetry mirror of the "Created B -> d" measurement
                 # contract (no-op unless telemetry is enabled).
                 telemetry.record_created(d.data)
+            if telemetry.dtrace_enabled():
+                # Lifeline join point: each payload digest leaves the
+                # queue-wait edge here, and the ``r<round>`` detail keys
+                # the batch timeline onto the round trace's ordering
+                # breakdown for this round.
+                name_label = repr(self.name)
+                for d in block.payload:
+                    telemetry.dtrace_event(
+                        name_label,
+                        telemetry.intern_label(d.data),
+                        "proposed",
+                        detail=f"r{round_}",
+                    )
             if self.benchmark:
                 for d in block.payload:
                     # NOTE: benchmark measurement interface (reference

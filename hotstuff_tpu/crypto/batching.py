@@ -128,7 +128,11 @@ class BatchingBackend:
     def _submit(self, req: _Request) -> None:
         with self._cv:
             self._pending.append(req)
-            if self._thread is None:
+            # is_alive, not None: a forked child (engine groups) inherits
+            # the parent's thread OBJECT but not the running thread — a
+            # None check would leave every request waiting on a flusher
+            # that does not exist in this process.
+            if self._thread is None or not self._thread.is_alive():
                 # Dedicated daemon flusher, started on first use. A
                 # caller-thread flusher (the previous design) either
                 # stalls its own caller for unbounded time under

@@ -140,6 +140,9 @@ class CommitResolver:
         self._m_fetched = telemetry.counter("mempool.resolver.batches_fetched")
         self._m_unresolved = telemetry.counter("mempool.resolver.unresolved")
         self._h_wait = telemetry.histogram("mempool.resolver.fetch_wait_ms")
+        # Lifeline node label: the dataplane knows whose commit stream
+        # this is; a standalone resolver (tests) traces as "".
+        self._node_label = repr(dataplane.name) if dataplane is not None else ""
 
     @classmethod
     def spawn(cls, *args, **kwargs) -> asyncio.Task:
@@ -155,12 +158,27 @@ class CommitResolver:
                     self.dataplane.note_committed(block.payload)
             await self.tx_out.put(block)
 
+    def _trace_resolved(self, digests, detail: str) -> None:
+        if not telemetry.dtrace_enabled():
+            return
+        for d in digests:
+            # Lifeline terminal mark: the batch bytes are materialized on
+            # this node's commit path (timeline closes here; a committed-
+            # but-never-resolved batch leaves this edge open).
+            telemetry.dtrace_event(
+                self._node_label,
+                telemetry.intern_label(d.data),
+                "resolved",
+                detail=detail,
+            )
+
     async def _resolve(self, block) -> None:
         missing = [
             d for d in block.payload if await self.store.read(d.data) is None
         ]
         self._m_resolved.inc(len(block.payload) - len(missing))
         if not missing:
+            self._trace_resolved(block.payload, "local")
             return
         # The certified quorum held the batch when it was ordered; pull it
         # through the mempool synchronizer's fetch/retry machinery.
@@ -182,6 +200,18 @@ class CommitResolver:
                 len(missing),
                 block,
             )
+            # The locally-present subset still resolved; the timed-out
+            # digests leave their lifeline open (the attribution reports
+            # the open edge, never invents a close).
+            unresolved = set(missing)
+            self._trace_resolved(
+                [d for d in block.payload if d not in unresolved], "local"
+            )
             return
         self._m_fetched.inc(len(missing))
         self._h_wait.observe((time.monotonic() - t0) * 1e3)
+        missing_set = set(missing)
+        self._trace_resolved(
+            [d for d in block.payload if d not in missing_set], "local"
+        )
+        self._trace_resolved(missing, "fetched")

@@ -84,7 +84,10 @@ class IngressHandler(MessageHandler):
         # Header peek only (serde ints are little-endian) — the hot path
         # never parses transactions.
         n_txs = int.from_bytes(message[1:5], "little")
-        if self.ingress.offer(message):
+        # Arrival stamp rides with the frame (perf_counter: the trace
+        # timebase) so the seal site can back-date the batch's lifeline
+        # ``ingress`` event — the hot path pays one clock read, no trace.
+        if self.ingress.offer((time.perf_counter(), message)):
             self._m_bundles.inc()
             self._m_txs.inc(n_txs)
         else:
@@ -120,6 +123,7 @@ class PeerWorkerHandler(MessageHandler):
         self._m_withheld = telemetry.counter(
             "faultline.injected.acks_withheld"
         )
+        self._node_label = repr(name)
 
     async def dispatch(self, writer, message: bytes) -> None:
         tag = message[0] if message else -1
@@ -160,6 +164,15 @@ class PeerWorkerHandler(MessageHandler):
             # our proposer too (committed duplicates are cleaned from
             # every proposer buffer on commit, reference behavior).
             await self.tx_consensus.put(cert.digest)
+            if telemetry.dtrace_enabled():
+                # Lifeline: a peer cert (wire v1 or v2 — decode handled
+                # both above) put this digest into OUR proposer queue.
+                telemetry.dtrace_event(
+                    self._node_label,
+                    telemetry.intern_label(cert.digest.data),
+                    "enqueue",
+                    detail="peer",
+                )
         elif tag == messages.TAG_BATCH_REQUEST:
             try:
                 digests, requestor = messages.decode_batch_request(message)
@@ -233,6 +246,8 @@ class Worker:
         self._m_dedup = telemetry.counter("mempool.worker.dedup_hits")
         self._g_ingress = telemetry.gauge("mempool.worker.ingress_depth")
         self._h_ack = telemetry.histogram("mempool.worker.ack_latency_ms")
+        # Lifeline events label their node once (repr is a base64 encode).
+        self._node_label = repr(name)
 
     async def spawn(self) -> "Worker":
         entry = self.committee.workers_of(self.name)[self.worker_id]
@@ -276,6 +291,7 @@ class Worker:
         n_txs = 0
         samples: list[int] = []
         size = 0
+        first_arrival: float | None = None
         deadline = time.monotonic() + max_delay
         while True:
             # Back-pressure gate: while store depth is above HIGH, stop
@@ -283,11 +299,16 @@ class Worker:
             await self.watermark.wait_ok()
             timeout = max(deadline - time.monotonic(), 0)
             try:
-                frame = await asyncio.wait_for(self.ingress.get(), timeout)
+                arrived, frame = await asyncio.wait_for(
+                    self.ingress.get(), timeout
+                )
             except asyncio.TimeoutError:
                 if segments:
-                    await self._seal(segments, n_txs, samples, size)
+                    await self._seal(
+                        segments, n_txs, samples, size, first_arrival
+                    )
                     segments, n_txs, samples, size = [], 0, [], 0
+                    first_arrival = None
                 deadline = time.monotonic() + max_delay
                 continue
             try:
@@ -304,17 +325,25 @@ class Worker:
             self._dedup[key] = None
             if len(self._dedup) > DEDUP_WINDOW:
                 self._dedup.popitem(last=False)
+            if first_arrival is None:
+                first_arrival = arrived
             segments.append(blob)
             n_txs += bundle_txs
             samples.extend(bundle_samples)
             size += messages.batch_tx_bytes(bundle_txs, blob)
             if size >= batch_size:
-                await self._seal(segments, n_txs, samples, size)
+                await self._seal(segments, n_txs, samples, size, first_arrival)
                 segments, n_txs, samples, size = [], 0, [], 0
+                first_arrival = None
                 deadline = time.monotonic() + max_delay
 
     async def _seal(
-        self, segments: list[bytes], n_txs: int, samples: list[int], size: int
+        self,
+        segments: list[bytes],
+        n_txs: int,
+        samples: list[int],
+        size: int,
+        first_arrival: float | None = None,
     ) -> None:
         serialized = messages.encode_worker_batch(
             self.worker_id, n_txs, samples, b"".join(segments)
@@ -323,9 +352,27 @@ class Worker:
         await self.store.write(digest.data, serialized)
         self._m_sealed.inc()
         self._m_bytes_out.inc(len(serialized) * len(self.peers))
+        batch_label = None
         if telemetry.enabled():
             self._g_ingress.set(self.ingress.qsize())
             telemetry.record_sealed(digest.data, size)
+        if telemetry.dtrace_enabled():
+            # Lifeline: the batch's timeline opens with the earliest
+            # contributing bundle's arrival (back-dated — the ingress hot
+            # path records nothing) and the seal instant. The seal detail
+            # carries the shard, the sizes, and the leading sample ids so
+            # the assembler can join client submit timestamps.
+            batch_label = telemetry.intern_label(digest.data)
+            if first_arrival is not None:
+                telemetry.dtrace_event(
+                    self._node_label, batch_label, "ingress", t=first_arrival
+                )
+            detail = f"w{self.worker_id}|{n_txs}tx|{size}B"
+            if samples:
+                detail += "|s" + ",".join(str(s) for s in samples[:4])
+            telemetry.dtrace_event(
+                self._node_label, batch_label, "seal", detail=detail
+            )
         if self.benchmark:
             for tx_id in samples:
                 # NOTE: benchmark measurement interface (same contract as
@@ -345,6 +392,12 @@ class Worker:
             (pk, await self.network.send(addr, serialized))
             for pk, addr in self.peers
         ]
+        if batch_label is not None:
+            # Every dissemination frame is with the ReliableSender now;
+            # first-ack minus this mark is the wire+store+sign edge.
+            telemetry.dtrace_event(
+                self._node_label, batch_label, "disseminate"
+            )
         if len(self._certifiers) >= CERTIFY_QUEUE_MAX:
             log.warning("certifier queue full; dropping batch %s", digest)
             self._m_cert_fail.inc()
@@ -352,7 +405,9 @@ class Worker:
                 h.cancel()
             return
         task = asyncio.create_task(
-            self._certify(digest, collector, handlers, time.monotonic())
+            self._certify(
+                digest, collector, handlers, time.monotonic(), batch_label
+            )
         )
         self._certifiers.add(task)
         task.add_done_callback(self._certifiers.discard)
@@ -365,8 +420,11 @@ class Worker:
         collector: CertCollector,
         handlers: list,
         t0: float,
+        label: str | None = None,
     ) -> None:
         pending = {h: pk for pk, h in handlers}
+        traced = label is not None and telemetry.dtrace_enabled()
+        first_ack_pending = traced
         cert: AvailabilityCert | None = (
             AvailabilityCert(digest, list(collector.pairs))
             if collector.complete()
@@ -390,6 +448,17 @@ class Worker:
                     self._m_bad_acks.inc()
                     continue
                 self._m_acks.inc()
+                if first_ack_pending:
+                    # One lifeline event for the FIRST verified seat ack
+                    # only: the assembler's fan-in edge is first-ack →
+                    # cert, and keeping the ack hot path to a single
+                    # event holds the attached-plane overhead under the
+                    # CI budget. The quorum size rides on the cert
+                    # event's detail.
+                    first_ack_pending = False
+                    telemetry.dtrace_event(
+                        self._node_label, label, "ack", detail=repr(signer)
+                    )
                 if maybe is not None:
                     cert = maybe
         if cert is None:
@@ -397,6 +466,11 @@ class Worker:
             self._m_cert_fail.inc()
             return
         self._h_ack.observe((time.monotonic() - t0) * 1e3)
+        if traced:
+            telemetry.dtrace_event(
+                self._node_label, label, "cert",
+                detail=f"a{len(cert.pairs)}",
+            )
         encoded = cert.encode(self.seats)
         await self.store.write(messages.cert_key(digest.data), encoded)
         self._m_certs.inc()
@@ -408,6 +482,10 @@ class Worker:
         # Only now does the digest reach consensus: ordering is gated on
         # proven availability.
         await self.tx_consensus.put(digest)
+        if traced:
+            telemetry.dtrace_event(
+                self._node_label, label, "enqueue", detail="own"
+            )
         if pending:
             # Give the slow minority a bounded grace period, then stop
             # retransmitting to them (they can sync later).

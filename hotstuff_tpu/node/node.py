@@ -181,6 +181,7 @@ class Node:
                 node=str(secret.name),
                 interval_s=telemetry.env_interval_s(),
                 trace=telemetry.trace_buffer(),
+                dtrace=telemetry.dtrace_buffer(),
             ).spawn()
             # Unclean teardown (SIGTERM from the local bench, atexit)
             # still flushes the final snapshot + trace tail and dumps the

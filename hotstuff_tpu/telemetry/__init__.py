@@ -63,6 +63,12 @@ from .registry import (
     Registry,
     diff_counters,
 )
+from .dtrace import (
+    DTRACE_SCHEMA,
+    build_dtrace_record,
+    intern_label,
+    validate_dtrace_record,
+)
 from .spans import RoundTrace
 from .trace import (
     FLIGHT_SCHEMA,
@@ -88,6 +94,7 @@ __all__ = [
     "SCHEMA",
     "META_SCHEMA",
     "TRACE_SCHEMA",
+    "DTRACE_SCHEMA",
     "FLIGHT_SCHEMA",
     "PROFILE_SCHEMA",
     "ALERT_SCHEMA",
@@ -113,8 +120,11 @@ __all__ = [
     "arm_shutdown_flush",
     "build_snapshot",
     "build_trace_record",
+    "build_dtrace_record",
+    "intern_label",
     "validate_snapshot",
     "validate_trace_record",
+    "validate_dtrace_record",
     "diff_counters",
     "dump_flight_record",
     "counter",
@@ -134,14 +144,22 @@ __all__ = [
     "round_trace",
     "trace_buffer",
     "trace_event",
+    "dtrace_buffer",
+    "dtrace_enabled",
+    "dtrace_event",
+    "set_dtrace_detached",
     "reset_for_tests",
 ]
 
 _REGISTRY = Registry()
 _TRACE_BUFFER = TraceBuffer()
+_DTRACE_BUFFER = TraceBuffer()
 _ENABLED = bool(
     os.environ.get("HOTSTUFF_TELEMETRY") or os.environ.get("HOTSTUFF_TELEMETRY_DIR")
 )
+# ``HOTSTUFF_DTRACE=0`` detaches ONLY the batch-lifecycle plane while the
+# rest of telemetry stays armed — the CI overhead gate's control leg.
+_DTRACE_DETACHED = os.environ.get("HOTSTUFF_DTRACE", "") == "0"
 
 
 class _NullCounter:
@@ -365,15 +383,56 @@ def trace_event(
         _TRACE_BUFFER.record(node, round_, stage, detail=detail)
 
 
+def dtrace_buffer() -> TraceBuffer:
+    """The process batch-lifecycle ring (live even when disabled, so the
+    emitter can be wired up before/without enablement)."""
+    return _DTRACE_BUFFER
+
+
+def dtrace_enabled() -> bool:
+    """Whether the batch-lifecycle plane records: telemetry must be on
+    AND ``HOTSTUFF_DTRACE=0`` must not have detached it. Instrumentation
+    sites gate label interning on this, so a detached run pays nothing
+    dtrace-specific."""
+    return _ENABLED and not _DTRACE_DETACHED
+
+
+def set_dtrace_detached(detached: bool) -> None:
+    """Runtime override of the ``HOTSTUFF_DTRACE=0`` detach switch.
+    This is the overhead smoke's paired-measurement hook (it alternates
+    the lifeline plane per batch inside one process); production code
+    configures the plane via the environment instead.
+    ``reset_for_tests`` recomputes the flag from the environment."""
+    global _DTRACE_DETACHED
+    _DTRACE_DETACHED = detached
+
+
+def dtrace_event(
+    node: str, digest, stage: str,
+    t: float | None = None, detail: str | None = None,
+) -> None:
+    """Record one batch-lifecycle event into the dtrace ring (no-op when
+    telemetry is disabled or the dtrace plane is detached). ``digest`` is
+    the batch digest's raw bytes (interned to the shared ``base64[:16]``
+    label) or an already-interned label string. ``t`` overrides the
+    timestamp — the seal site back-dates the ``ingress`` event to the
+    bundle's recorded arrival instant."""
+    if _ENABLED and not _DTRACE_DETACHED:
+        label = digest if isinstance(digest, str) else intern_label(digest)
+        _DTRACE_BUFFER.record(node, label, stage, t=t, detail=detail)
+
+
 def reset_for_tests() -> None:
     """Clear registry, tables, trace ring, and enablement (isolation)."""
-    global _ENABLED
-    from . import profiler as _profiler, resources as _resources
+    global _ENABLED, _DTRACE_DETACHED
+    from . import dtrace as _dtrace, profiler as _profiler, resources as _resources
 
     _profiler.reset_for_tests()
     _resources.reset_for_tests()
+    _dtrace.reset_for_tests()
     _REGISTRY.reset()
     _TRACE_BUFFER.clear()
+    _DTRACE_BUFFER.clear()
     with _tables_lock:
         _proposed.clear()
         _sealed.clear()
@@ -381,3 +440,4 @@ def reset_for_tests() -> None:
         os.environ.get("HOTSTUFF_TELEMETRY")
         or os.environ.get("HOTSTUFF_TELEMETRY_DIR")
     )
+    _DTRACE_DETACHED = os.environ.get("HOTSTUFF_DTRACE", "") == "0"

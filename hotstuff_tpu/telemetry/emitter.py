@@ -35,6 +35,7 @@ import signal
 import time
 
 from . import profiler as pyprof
+from .dtrace import build_dtrace_record
 from .trace import build_trace_record, dump_flight_record
 
 log = logging.getLogger("telemetry")
@@ -57,13 +58,17 @@ def build_meta_record(
     timestamps on a shared timeline, and the writer pid (restarts of the
     same node produce a new meta record mid-stream: a visible epoch
     boundary, not a silent counter reset)."""
+    from .dtrace import DTRACE_SCHEMA
     from .profiler import PROFILE_SCHEMA
     from .trace import TRACE_SCHEMA
     from .watchtower import ALERT_SCHEMA
 
     return {
         "schema": META_SCHEMA,
-        "schemas": [SCHEMA, TRACE_SCHEMA, PROFILE_SCHEMA, ALERT_SCHEMA],
+        "schemas": [
+            SCHEMA, TRACE_SCHEMA, DTRACE_SCHEMA, PROFILE_SCHEMA,
+            ALERT_SCHEMA,
+        ],
         "node": node,
         "pid": os.getpid(),
         "ts": time.time(),
@@ -174,6 +179,7 @@ class TelemetryEmitter:
         node: str = "",
         interval_s: float = DEFAULT_INTERVAL_S,
         trace=None,
+        dtrace=None,
         profiler=None,
     ) -> None:
         self.registry = registry
@@ -181,11 +187,13 @@ class TelemetryEmitter:
         self.node = node
         self.interval_s = max(float(interval_s), 0.05)
         self.trace = trace  # TraceBuffer or None
+        self.dtrace = dtrace  # batch-lifecycle TraceBuffer or None
         # SamplingProfiler, or None to follow the process-active session
         # lazily (nodes arm the profiler from the environment after the
         # emitter exists; a fixed None would silently drop its records).
         self.profiler = profiler
         self._trace_seq = 0  # last trace event seq already streamed
+        self._dtrace_seq = 0  # last dtrace event seq already streamed
         self._seq = 0
         self._final_done = False
         self._meta_done = False
@@ -229,6 +237,16 @@ class TelemetryEmitter:
             if events:
                 self._trace_seq = events[-1][0]
                 record = build_trace_record(self.trace, events, node=self.node)
+                lines.append(json.dumps(record, separators=(",", ":")))
+        if self.dtrace is not None:
+            # Batch-lifecycle events ride the same stream as their own
+            # delta line (same contract as the round trace above).
+            events = self.dtrace.events_since(self._dtrace_seq)
+            if events:
+                self._dtrace_seq = events[-1][0]
+                record = build_dtrace_record(
+                    self.dtrace, events, node=self.node
+                )
                 lines.append(json.dumps(record, separators=(",", ":")))
         prof = self.profiler if self.profiler is not None else pyprof.active()
         if prof is not None:

@@ -153,6 +153,7 @@ def default_slos(
 def dataplane_slos(
     *,
     worker_store_depth: float = 512.0,
+    digest_queue_growth_per_s: float = 50.0,
     allow_violation_fraction: float = 0.0,
 ) -> list[SloSpec]:
     """The Conveyor data-plane gate set. Streams without the worker
@@ -164,7 +165,13 @@ def dataplane_slos(
       queue-collapse failure mode this plane exists to prevent);
     - ``resolver_unresolved`` — the commit path must NEVER time out
       resolving a certified digest to its batch (max 0 per second: one
-      occurrence is an availability violation, not degradation).
+      occurrence is an availability violation, not degradation);
+    - ``digest_queue_growth_per_s`` — the proposer's certified-digest
+      queue must not GROW faster than the bound in any window (ROADMAP
+      3b: ordering starving behind ingest). Growth, not depth: a deep
+      queue that drains as fast as it fills is healthy pipelining; the
+      watchtower's ``digest_queue_starvation`` detector judges the same
+      gauge online.
     """
     return [
         SloSpec(
@@ -176,6 +183,12 @@ def dataplane_slos(
             "resolver_unresolved", "rate",
             "mempool.resolver.unresolved", max=0.0,
             allow_violation_fraction=0.0,
+        ),
+        SloSpec(
+            "digest_queue_growth_per_s", "gauge_growth",
+            "consensus.proposer.digest_queue_depth",
+            max=digest_queue_growth_per_s,
+            allow_violation_fraction=allow_violation_fraction,
         ),
     ]
 

@@ -24,6 +24,7 @@ import argparse
 import json
 import sys
 
+from .dtrace import DTRACE_SCHEMA, validate_dtrace_record
 from .emitter import META_SCHEMA, SCHEMA, validate_meta_record, validate_snapshot
 from .profiler import PROFILE_SCHEMA, validate_profile_record
 from .trace import TRACE_SCHEMA, validate_trace_record
@@ -32,6 +33,7 @@ from .watchtower import ALERT_SCHEMA, validate_alert_record
 VALIDATORS = {
     SCHEMA: validate_snapshot,
     TRACE_SCHEMA: validate_trace_record,
+    DTRACE_SCHEMA: validate_dtrace_record,
     PROFILE_SCHEMA: validate_profile_record,
     META_SCHEMA: validate_meta_record,
     ALERT_SCHEMA: validate_alert_record,

@@ -88,6 +88,7 @@ DETECTORS = (
     "grinding_leader",
     "partitioned_clique",
     "slope_breach",
+    "digest_queue_starvation",
     "equivocation",
 )
 
@@ -173,6 +174,11 @@ class WatchtowerConfig:
     rss_growth_max_bytes_per_s: float = 8 * 1024 * 1024
     store_growth_max_bytes_per_s: float = 32 * 1024 * 1024
     slope_window_s: float = 10.0
+    #: sustained growth of the proposer's certified-digest queue
+    #: (digests/s over slope_window_s) before ordering is judged to be
+    #: starving behind ingest. A queue that merely sits deep but drains
+    #: as fast as it fills does not fire — growth is the signal.
+    digest_queue_growth_max_per_s: float = 50.0
     #: per-(detector, accused-set) re-alert backoff, seconds.
     cooldown_s: float = 15.0
     #: alert ring bound (oldest dropped; never grows without bound).
@@ -298,6 +304,9 @@ class Watchtower:
         # Per-stream state: wall-clock anchors and resource history.
         self._anchors: dict[str, float] = {}  # source -> wall-mono offset
         self._resources: dict[str, deque] = {}  # node -> (ts, pid, gauges)
+        # Proposer digest-queue depth history per node (ROADMAP 3b: the
+        # ordering-starved-behind-ingest inversion, judged by slope).
+        self._digest_queue: dict[str, deque] = {}  # node -> (ts, pid, depth)
         # Conveyor worker health per stream node (latest snapshot wins).
         self._worker_stats: dict[str, dict] = {}
         self._meta: dict[str, dict] = {}
@@ -464,6 +473,7 @@ class Watchtower:
                 worker[label] = v
         if worker:
             self._worker_stats[node] = worker
+        fired += self._check_digest_queue(node, snap, gauges, ts)
         tracked = {
             k: gauges[k]
             for k in ("resource.rss_bytes", "resource.store_bytes")
@@ -509,6 +519,50 @@ class Watchtower:
                     window=(base[0], ts),
                 )
         return fired
+
+    def _check_digest_queue(
+        self, node: str, snap: dict, gauges: dict, ts: float
+    ) -> list[dict]:
+        """Sustained growth of ``consensus.proposer.digest_queue_depth``
+        — certified digests arriving faster than proposals drain them,
+        the ordering-starves-behind-ingest inversion the data plane
+        exists to prevent. Same slope machinery as the resource
+        detectors: base sample ≥ slope_window_s back, growth judged in
+        digests/s, a process restart clears the history."""
+        depth = gauges.get("consensus.proposer.digest_queue_depth")
+        if not isinstance(depth, (int, float)):
+            return []
+        hist = self._digest_queue.setdefault(node, deque(maxlen=64))
+        pid = snap.get("pid")
+        if hist and hist[-1][1] != pid:
+            hist.clear()
+        hist.append((ts, pid, depth))
+        cfg = self.config
+        base = None
+        for old_ts, _pid, old_depth in hist:
+            if ts - old_ts >= cfg.slope_window_s:
+                base = (old_ts, old_depth)
+            else:
+                break
+        if base is None:
+            return []
+        secs = ts - base[0]
+        growth = (depth - base[1]) / secs if secs > 0 else 0.0
+        bound = cfg.digest_queue_growth_max_per_s
+        if growth <= bound:
+            return []
+        return self._alert(
+            "digest_queue_starvation",
+            [node],
+            min(1.0, 0.5 + 0.5 * (growth / bound - 1.0)),
+            ts,
+            {"metric": "consensus.proposer.digest_queue_depth",
+             "depth": depth,
+             "growth_per_s": round(growth, 1),
+             "max_per_s": bound,
+             "window_s": round(secs, 1)},
+            window=(base[0], ts),
+        )
 
     # -- windowing -----------------------------------------------------------
 

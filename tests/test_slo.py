@@ -283,6 +283,7 @@ def test_dataplane_slos_gate_depth_and_unresolved():
     specs = slo.dataplane_slos(worker_store_depth=100.0)
     assert [s.name for s in specs] == [
         "worker_store_depth", "resolver_unresolved",
+        "digest_queue_growth_per_s",
     ]
     # Bounded depth + zero resolution timeouts: green.
     ok_snaps = [

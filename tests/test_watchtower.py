@@ -344,6 +344,109 @@ def test_slope_breach_near_miss_under_bound():
     assert fired == []
 
 
+# -- digest_queue_starvation (ROADMAP 3b: ordering starving behind ingest) ---
+
+
+def _queue_snapshot(ts, node, pid, depth):
+    return {
+        "schema": "hotstuff-telemetry-v1",
+        "node": node,
+        "pid": pid,
+        "seq": 0,
+        "ts": ts,
+        "final": False,
+        "counters": {},
+        "gauges": {"consensus.proposer.digest_queue_depth": depth},
+        "histograms": {},
+    }
+
+
+def test_digest_queue_starvation_fires_on_sustained_growth():
+    cfg = WatchtowerConfig(slope_window_s=5.0, digest_queue_growth_max_per_s=50.0)
+    watch = Watchtower(cfg)
+    fired = []
+    for i in range(8):
+        # 200 digests/s of sustained queue growth, 4x the bound.
+        fired += watch.ingest_record(
+            _queue_snapshot(i * 2.0, "n1", 42, i * 400), source="s"
+        )
+    alerts = [a for a in fired if a["detector"] == "digest_queue_starvation"]
+    assert alerts and alerts[0]["accused"] == ["n1"]
+    assert (
+        alerts[0]["evidence"]["metric"]
+        == "consensus.proposer.digest_queue_depth"
+    )
+    assert alerts[0]["evidence"]["growth_per_s"] > 50.0
+    from hotstuff_tpu.telemetry import validate_alert_record
+
+    assert validate_alert_record(alerts[0]) == []
+
+
+def test_digest_queue_starvation_near_miss_under_bound():
+    """Growth just UNDER the bound must stay silent — the detector
+    judges sustained slope against the configured bound, not busyness."""
+    cfg = WatchtowerConfig(slope_window_s=5.0, digest_queue_growth_max_per_s=50.0)
+    watch = Watchtower(cfg)
+    fired = []
+    for i in range(8):
+        # 45 digests/s: close to, but inside, the 50/s bound.
+        fired += watch.ingest_record(
+            _queue_snapshot(i * 2.0, "n1", 42, i * 90), source="s"
+        )
+    assert fired == []
+
+
+def test_digest_queue_deep_but_draining_is_healthy():
+    """A deep-but-flat queue is pipelining, not starvation: depth alone
+    never fires, only growth does."""
+    cfg = WatchtowerConfig(slope_window_s=5.0, digest_queue_growth_max_per_s=50.0)
+    watch = Watchtower(cfg)
+    fired = []
+    for i in range(8):
+        fired += watch.ingest_record(
+            _queue_snapshot(i * 2.0, "n1", 42, 40_000 + (i % 2) * 10),
+            source="s",
+        )
+    assert fired == []
+
+
+def test_digest_queue_starvation_restart_clears_history():
+    cfg = WatchtowerConfig(slope_window_s=5.0, digest_queue_growth_max_per_s=50.0)
+    watch = Watchtower(cfg)
+    fired = []
+    fired += watch.ingest_record(_queue_snapshot(0.0, "n1", 41, 0), "s")
+    fired += watch.ingest_record(_queue_snapshot(6.0, "n1", 41, 10), "s")
+    # Restart: fresh pid; a large absolute jump across lives is not growth.
+    fired += watch.ingest_record(_queue_snapshot(12.0, "n1", 99, 5_000), "s")
+    fired += watch.ingest_record(_queue_snapshot(18.0, "n1", 99, 5_010), "s")
+    assert [
+        a for a in fired if a["detector"] == "digest_queue_starvation"
+    ] == []
+
+
+def test_dataplane_slos_include_digest_queue_growth():
+    from hotstuff_tpu.telemetry import slo as slo_mod
+
+    specs = {s.name: s for s in slo_mod.dataplane_slos()}
+    spec = specs["digest_queue_growth_per_s"]
+    assert spec.kind == "gauge_growth"
+    assert spec.metric == "consensus.proposer.digest_queue_depth"
+    # Two snapshots 10 s apart growing 100 digests/s: violated; near-miss
+    # growth under the bound: healthy.
+    hot = [
+        _queue_snapshot(0.0, "n1", 1, 0),
+        _queue_snapshot(10.0, "n1", 1, 1_000),
+    ]
+    cool = [
+        _queue_snapshot(0.0, "n1", 1, 0),
+        _queue_snapshot(10.0, "n1", 1, 400),
+    ]
+    bad = slo_mod.evaluate_streams({"s": hot}, [spec], window_s=10.0)
+    good = slo_mod.evaluate_streams({"s": cool}, [spec], window_s=10.0)
+    assert not bad["ok"]
+    assert good["ok"]
+
+
 # -- alert plumbing ----------------------------------------------------------
 
 
