@@ -261,6 +261,7 @@ def run_faults(args) -> None:
             base_port=base_port,
             timeout_delay=args.timeout,
             leader_elector=args.leader_elector,
+            retention_rounds=args.retention_rounds,
             # Committee-size-aware recovery bound: post-heal the whole
             # committee must timeout-sync and re-quorum; at N=100 that
             # is minutes of real work on one core, not the N=4 seconds.
@@ -289,12 +290,23 @@ def run_faults(args) -> None:
         "trace": json.loads(traces[0]),
         "faultline_counters": fault_counters,
     }
-    ok = verdict["safety"]["ok"] and verdict["liveness"]["recovered"]
+    frontier = verdict.get("frontier_availability")
+    ok = (
+        verdict["safety"]["ok"]
+        and verdict["liveness"]["recovered"]
+        and (frontier is None or frontier["ok"])
+    )
     print(
         f"faultline scenario={scenario.name} seed={scenario.seed} "
         f"nodes={args.nodes}: safety={'ok' if verdict['safety']['ok'] else 'VIOLATED'} "
         f"liveness={'recovered' if verdict['liveness']['recovered'] else 'STALLED'} "
-        f"commits={verdict['commits']} "
+        + (
+            f"frontier={'ok' if frontier['ok'] else 'UNSERVABLE'} "
+            f"floors={frontier['floors']} "
+            if frontier is not None
+            else ""
+        )
+        + f"commits={verdict['commits']} "
         f"injections={verdict['injections']['counts']}"
     )
     if args.output:
@@ -351,6 +363,14 @@ def main() -> None:
         "--leader-elector",
         default="",
         help="with --faults: consensus leader elector (e.g. reputation)",
+    )
+    p.add_argument(
+        "--retention-rounds",
+        type=int,
+        default=0,
+        help="with --faults: arm snapshot/truncate log compaction at this "
+        "retention depth (rounds; 0 = disabled) — the verdict then also "
+        "gates on the frontier-availability invariant",
     )
     p.add_argument(
         "--profile",

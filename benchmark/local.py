@@ -56,6 +56,7 @@ class LocalBench:
         telemetry: bool = False,
         chaos: str | None = None,
         workers: int = 0,
+        retention_rounds: int = 0,
     ) -> None:
         self.nodes = nodes
         self.rate = rate
@@ -83,6 +84,9 @@ class LocalBench:
         # base + (4 + 2w) * n + i (peer port). Clients switch to the
         # sharded bundle generator targeting their node's ingress ports.
         self.workers = workers
+        # Lazarus: snapshot/truncate retention depth in rounds (0 =
+        # unbounded store, the historic behavior).
+        self.retention_rounds = retention_rounds
         self._procs: list[subprocess.Popen] = []
         self._node_procs: dict[int, subprocess.Popen] = {}
         self._node_cmds: dict[int, tuple[list, str]] = {}  # i -> (cmd, log)
@@ -171,7 +175,10 @@ class LocalBench:
         Committee(consensus, mempool).write(committee_file)
         params_file = os.path.join(self.work_dir, "parameters.json")
         Parameters(
-            CParams(timeout_delay=self.timeout_delay),
+            CParams(
+                timeout_delay=self.timeout_delay,
+                retention_rounds=self.retention_rounds,
+            ),
             MParams(
                 batch_size=self.batch_size,
                 max_batch_delay=self.max_batch_delay,

@@ -73,6 +73,7 @@ def run_soak(args) -> dict:
         telemetry=True,
         chaos=chaos_path,
         workers=args.workers,
+        retention_rounds=args.retention_rounds,
     )
     logs_dir = os.path.join(work_dir, "logs")
 
@@ -140,9 +141,16 @@ def run_soak(args) -> dict:
             # The unbounded-growth gate (ROADMAP item 4): RSS and store
             # disk must grow slower than the bound in every window. The
             # resource gauges come from each node's resource collector;
-            # streams without them skip these specs.
+            # streams without them skip these specs. With retention
+            # armed, the bounded-store contract additionally caps the
+            # ABSOLUTE store size (compaction must plateau it).
             rss_growth_bytes_per_s=args.rss_growth_mb_s * 1024 * 1024,
             store_growth_bytes_per_s=args.store_growth_mb_s * 1024 * 1024,
+            store_bytes_max=(
+                args.store_max_mb * 1024 * 1024
+                if args.store_max_mb is not None
+                else None
+            ),
             allow_violation_fraction=args.allow_violation_fraction,
         )
     )
@@ -304,6 +312,8 @@ def run_soak(args) -> dict:
             "chaos_seed": args.chaos_seed,
             "chaos_scenario": getattr(args, "chaos_scenario", None),
             "workers": args.workers,
+            "retention_rounds": args.retention_rounds,
+            "store_max_mb": args.store_max_mb,
             "slo_window_s": args.window,
         },
         "slo": slo_verdict,
@@ -365,6 +375,17 @@ def main() -> None:
         help="memory-growth SLO: max on-disk store growth (MiB/s)",
     )
     p.add_argument(
+        "--retention-rounds", type=int, default=0,
+        help="Lazarus: arm snapshot/truncate log compaction in every "
+        "node at this retention depth (rounds; 0 = unbounded store)",
+    )
+    p.add_argument(
+        "--store-max-mb", type=float, default=None,
+        help="absolute on-disk store cap per node (gauge_max SLO on "
+        "resource.store_bytes); defaults to 512 MiB when "
+        "--retention-rounds is armed, off otherwise",
+    )
+    p.add_argument(
         "--dtrace", action="store_true",
         help="join the per-batch lifeline attribution (edge stats, cost "
         "centers, stuck-batch census) into the verdict",
@@ -390,6 +411,10 @@ def main() -> None:
     args = p.parse_args()
     if args.hours is not None:
         args.duration = int(args.hours * 3600)
+    if args.store_max_mb is None and args.retention_rounds > 0:
+        # Bounded-store contract: a retention-armed soak gates on an
+        # absolute store cap by default (compaction must plateau it).
+        args.store_max_mb = 512.0
 
     verdict = run_soak(args)
     print(json.dumps({k: v for k, v in verdict.items() if k != "summary"},
@@ -406,6 +431,8 @@ def main() -> None:
             tag = "clean"
         if args.workers:
             tag = f"w{args.workers}-{tag}"
+        if args.retention_rounds:
+            tag = f"r{args.retention_rounds}-{tag}"
         path = os.path.join(
             args.output,
             f"soak-slo-n{args.nodes}-{args.duration}s-{tag}.json",

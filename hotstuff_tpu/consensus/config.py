@@ -42,11 +42,19 @@ class Parameters:
     # migrated by flipping the config per epoch — nodes still on v1
     # interoperate throughout. HOTSTUFF_WIRE_V2=0 force-disables.
     wire_v2: bool = True
+    # Snapshot/truncate retention depth in committed rounds (Lazarus):
+    # the store keeps roughly this many rounds of chain below the commit
+    # head, truncating the rest behind a certified snapshot frontier —
+    # store growth bounded by retention, not uptime. 0 disables
+    # compaction entirely (full history retained, the historic behavior).
+    retention_rounds: int = 0
 
     def log(self) -> None:
         # Picked up by the benchmark log parser (reference ``config.rs:25-31``).
         log.info("Timeout delay set to %d ms", self.timeout_delay)
         log.info("Sync retry delay set to %d ms", self.sync_retry_delay)
+        if self.retention_rounds > 0:
+            log.info("Store retention set to %d rounds", self.retention_rounds)
 
 
 @dataclass

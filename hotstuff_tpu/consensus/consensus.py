@@ -26,6 +26,7 @@ from .leader import make_elector
 from .mempool_driver import MempoolDriver
 from .messages import SeatTable, decode_vote_frame
 from .proposer import Proposer
+from .statesync import Compactor, StateSync
 from .synchronizer import Synchronizer
 
 log = logging.getLogger("consensus")
@@ -165,6 +166,15 @@ class Consensus:
         self.synchronizer = Synchronizer(
             name, committee, store, tx_loopback, parameters.sync_retry_delay
         )
+        # Lazarus replica lifecycle: every real node answers state probes
+        # and runs the (dormant-while-healthy) anti-entropy tick; the
+        # compactor arms only when a retention depth is configured.
+        statesync = StateSync(name, committee, parameters.sync_retry_delay)
+        compactor = (
+            Compactor(store, parameters.retention_rounds)
+            if parameters.retention_rounds > 0
+            else None
+        )
 
         self.tasks.append(
             Core.spawn(
@@ -186,6 +196,8 @@ class Consensus:
                 on_round_advance=on_round_advance,
                 profile=profile,
                 wire_seats=wire_seats,
+                statesync=statesync,
+                compactor=compactor,
             )
         )
         self.tasks.append(

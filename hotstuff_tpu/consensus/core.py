@@ -73,6 +73,12 @@ class Core:
     _g_round = _g_committed_round = telemetry.NULL_GAUGE
     _trace = None
     _wire_seats = None  # state-only instances broadcast legacy v1
+    # Lazarus replica-lifecycle collaborators: None on state-only
+    # instances and on nodes that opted out (statesync/compaction are
+    # wired by Consensus.spawn when configured).
+    _statesync = None
+    _compactor = None
+    _last_committed_digest = None  # newest committed block's digest
 
     def __init__(
         self,
@@ -96,6 +102,8 @@ class Core:
         wire_seats=None,
         network=None,
         timer=None,
+        statesync=None,
+        compactor=None,
     ) -> None:
         self.name = name
         self.committee = committee
@@ -175,6 +183,10 @@ class Core:
         # carry the SAME block hash — one encode per round, not per vote.
         self._peer_labels: dict = {}
         self._vote_digest_memo: tuple[bytes, str] | None = None
+        # Replica lifecycle (Lazarus): anti-entropy state sync and
+        # snapshot/truncate compaction, both driven by this event loop.
+        self._statesync = statesync
+        self._compactor = compactor
         # This node's verified-certificate memory: rebroadcast QCs/TCs
         # (every view-change timeout carries the same high_qc; every
         # TC-former broadcasts the TC; timers retransmit) verify once
@@ -267,6 +279,8 @@ class Core:
             to_commit.append(ancestor)
             parent = ancestor
         self.last_committed_round = block.round
+        # Commit frontier: what state_request probes are answered with.
+        self._last_committed_digest = block.digest()
 
         for blk in reversed(to_commit):
             self._m_blocks.inc()
@@ -312,7 +326,11 @@ class Core:
             # Committed blocks (in commit order) feed the elector's
             # participation window (no-op for round-robin).
             self.leader_elector.update(blk)
+            if self._compactor is not None:
+                self._compactor.note_commit(blk)
             await self.tx_commit.put(blk)
+        if self._compactor is not None:
+            await self._compactor.maybe_compact(self)
 
     def update_high_qc(self, qc: QC) -> None:
         if qc.round > self.high_qc.round:
@@ -828,6 +846,22 @@ class Core:
             return
         await self.process_block(block)
 
+    # -- Lazarus state sync (thin delegates: the protocol driver lives in
+    # consensus/statesync.py; events reach it through the merged queue so
+    # the simulation plane drives the identical code path) ------------------
+
+    async def handle_state_request(self, payload) -> None:
+        if self._statesync is not None:
+            await self._statesync.handle_state_request(payload)
+
+    async def handle_state_response(self, payload) -> None:
+        if self._statesync is not None:
+            await self._statesync.handle_state_response(payload)
+
+    async def handle_statesync_tick(self, payload) -> None:
+        if self._statesync is not None:
+            await self._statesync.handle_tick(payload)
+
     async def handle_tc(self, tc: TC) -> None:
         # Round check BEFORE the 2f+1-signature verification: every node
         # that forms the TC broadcasts it, so all but the first arrival
@@ -863,6 +897,9 @@ class Core:
         "tc": "handle_tc",
         "qc_retry": "_handle_qc_retry",  # internal loopback
         "loopback": "process_block",
+        "state_request": "handle_state_request",
+        "state_response": "handle_state_response",
+        "statesync_tick": "handle_statesync_tick",  # internal loopback
     }
 
     # Sampling-profiler stage seeds: each dequeued event opens under the
@@ -878,6 +915,9 @@ class Core:
         "tc": "view_change",
         "qc_retry": "verify",
         "loopback": "vote",
+        "state_request": "ingress",
+        "state_response": "ingress",
+        "statesync_tick": "ingress",
     }
 
     def bound_handlers(self) -> dict:
@@ -900,6 +940,11 @@ class Core:
 
     async def run(self) -> None:
         await self._restore_state()
+        if self._statesync is not None:
+            # Restore the truncation floor from our own snapshot record
+            # and arm the anti-entropy probe loop (dormant while commits
+            # flow).
+            await self._statesync.start(self)
         self.timer.reset()
         if self.name == self.leader_elector.get_leader(self.round):
             await self.generate_proposal(None)

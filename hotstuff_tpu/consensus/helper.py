@@ -12,7 +12,8 @@ from hotstuff_tpu.store import Store
 from hotstuff_tpu.utils.tasks import log_task_death
 
 from .config import Committee
-from .messages import Block, encode_propose
+from .messages import Block, encode_propose, encode_state_response
+from .statesync import SNAPSHOT_KEY, SnapshotError, peek_frontier
 
 log = logging.getLogger("consensus")
 
@@ -75,6 +76,25 @@ class Helper:
                             cur = Block.deserialize(pdata)
                             network.send(address, encode_propose(cur))
                             sent += 1
+                    else:
+                        # Unservable digest — most likely truncated below
+                        # our snapshot horizon. Answer with the snapshot
+                        # record (frontier + 2-chain commit proof) so a
+                        # cold joiner establishes a verified floor instead
+                        # of re-requesting an unservable block forever.
+                        snap = await store.read_meta(SNAPSHOT_KEY)
+                        if snap is not None:
+                            try:
+                                round_, frontier = peek_frontier(snap)
+                            except SnapshotError as e:
+                                log.error("corrupt snapshot record: %s", e)
+                            else:
+                                network.send(
+                                    address,
+                                    encode_state_response(
+                                        round_, frontier, snap
+                                    ),
+                                )
                 except asyncio.CancelledError:
                     raise
                 except Exception as e:

@@ -939,6 +939,8 @@ TAG_VOTE = 1
 TAG_TIMEOUT = 2
 TAG_TC = 3
 TAG_SYNC_REQUEST = 4
+TAG_STATE_REQUEST = 5
+TAG_STATE_RESPONSE = 6
 
 
 def encode_propose(block: Block, seats: "SeatTable | None" = None) -> bytes:
@@ -977,6 +979,27 @@ def encode_tc(tc: TC, seats: "SeatTable | None" = None) -> bytes:
 
 def encode_sync_request(missing: Digest, origin: PublicKey) -> bytes:
     return Encoder().u8(TAG_SYNC_REQUEST).raw(missing.data).raw(origin.data).finish()
+
+
+def encode_state_request(since_round: int, origin: PublicKey) -> bytes:
+    """Anti-entropy frontier probe: ``origin`` asks a peer where the quorum
+    commit frontier is, declaring its own committed round so the peer can
+    decide whether a snapshot is worth attaching."""
+    return Encoder().u8(TAG_STATE_REQUEST).u64(since_round).raw(origin.data).finish()
+
+
+def encode_state_response(
+    frontier_round: int, frontier: Digest, snapshot: bytes | None
+) -> bytes:
+    """Reply to a state request (or to a sync request for a truncated
+    digest): the peer's committed frontier, optionally carrying its snapshot
+    record so a cold joiner can establish a verified floor."""
+    enc = Encoder().u8(TAG_STATE_RESPONSE)
+    enc.u8(1 if snapshot is not None else 0)
+    enc.u64(frontier_round).raw(frontier.data)
+    if snapshot is not None:
+        enc.raw(snapshot)
+    return enc.finish()
 
 
 # Fixed Vote wire layout (TAG_VOTE + Vote.encode):
@@ -1035,6 +1058,19 @@ def decode_message(data: bytes, seats: "SeatTable | None" = None):
         out = ("tc", TC.decode(dec, seats))
     elif tag == TAG_SYNC_REQUEST:
         out = ("sync_request", (Digest(dec.raw(32)), PublicKey(dec.raw(32))))
+    elif tag == TAG_STATE_REQUEST:
+        out = ("state_request", (dec.u64(), PublicKey(dec.raw(32))))
+    elif tag == TAG_STATE_RESPONSE:
+        has_snapshot = dec.u8()
+        if has_snapshot not in (0, 1):
+            raise errors.MalformedMessage("state_response snapshot flag")
+        round = dec.u64()
+        digest = Digest(dec.raw(32))
+        # tag(1) + flag(1) + round(8) + digest(32) = 42 bytes consumed; the
+        # snapshot record is the whole remaining tail (self-describing codec).
+        snapshot = bytes(dec.raw(len(data) - 42)) if has_snapshot else None
+        dec.finish()
+        return ("state_response", (round, digest, snapshot))
     else:
         raise errors.MalformedMessage(f"unknown consensus tag {tag}")
     dec.finish()

@@ -1,0 +1,420 @@
+"""Lazarus: replica state sync + snapshot/truncate log compaction.
+
+Two cooperating pieces, both driven by the Core's event loop (no new tasks,
+so the sans-io simulation plane runs them unmodified):
+
+**StateSync** — anti-entropy catch-up for cold-joining or lagging replicas.
+A node whose commit frontier stops advancing probes peers (one per tick,
+rotating, at ``sync_retry_delay`` cadence) with a ``state_request`` carrying
+its own committed round. Peers answer with their commit frontier and — when
+the requester is below their truncation horizon — their snapshot record.
+The joiner verifies the snapshot's 2-chain commit proof through the normal
+batch crypto path BEFORE adopting anything, installs the frontier as a
+verified floor, then pulls the remaining suffix through the ordinary
+Synchronizer/Helper chain machinery. Once commits flow, the probe loop goes
+dormant: a healthy committee pays one queue event per ``sync_retry_delay``.
+
+**Compactor** — snapshot + truncate. Every ``retention_rounds`` of commit
+progress it selects a frontier block ``F`` about ``retention_rounds`` behind
+the commit head such that the committed chain contains ``c1`` with
+``c1.round == F.round + 1`` (the 2-chain commit pattern), writes a snapshot
+record ``(F, c1, cert)`` — where ``c1.qc`` certifies ``F`` and ``cert`` is
+the QC certifying ``c1`` — durably to the MetaLog, then rewrites
+``store.log`` dropping every block (and its payload batch keys) strictly
+below ``F``. Store growth is thereby bounded by retention depth, not
+uptime.
+
+Why the proof is sound against byzantine servers: ``c1.qc`` certifies
+``F``'s digest at ``F``'s round, ``cert`` certifies ``c1`` at the NEXT
+round — exactly the consecutive-round 2-chain that commits ``F``. Both QCs
+carry 2f+1 signatures over content that binds the full chain topology, so a
+byzantine peer cannot present a certified-but-abandoned fork block as a
+committed frontier: no such block ever collects the consecutive-round
+child certificate.
+
+Crash discipline: the snapshot record is fsynced BEFORE the log rewrite
+(a crash between them restarts with the floor known and the old log
+intact); the rewrite itself is tmp + fsync + ``os.replace`` (see
+``LogEngine.compact``), so a crash at any point yields one complete log.
+"""
+
+from __future__ import annotations
+
+import logging
+
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.crypto import Digest, PublicKey
+from hotstuff_tpu.utils.serde import Decoder, Encoder, SerdeError
+
+from .config import Committee
+from .crypto_bridge import verify_off_loop
+from .errors import ConsensusError
+from .messages import (
+    QC,
+    Block,
+    encode_state_request,
+    encode_state_response,
+)
+
+log = logging.getLogger("consensus")
+
+#: MetaLog key of the snapshot record (overwrite semantics, never in the
+#: data log — so truncation can never drop its own floor record).
+SNAPSHOT_KEY = b"__store_snapshot__"
+
+_SNAPSHOT_VERSION = 1
+
+
+class SnapshotError(ConsensusError):
+    """Malformed or unproven snapshot record (byzantine or corrupt)."""
+
+
+class Snapshot:
+    """Decoded snapshot record: frontier ``F``, its consecutive-round child
+    ``c1`` (whose ``qc`` certifies ``F``), and ``cert`` — the QC certifying
+    ``c1``. ``last_voted_round`` is the creator's voting-state hint."""
+
+    __slots__ = ("frontier", "child", "cert", "last_voted_round")
+
+    def __init__(
+        self, frontier: Block, child: Block, cert: QC, last_voted_round: int
+    ) -> None:
+        self.frontier = frontier
+        self.child = child
+        self.cert = cert
+        self.last_voted_round = last_voted_round
+
+    def __repr__(self) -> str:
+        return f"Snapshot(F=r{self.frontier.round}, c1=r{self.child.round})"
+
+
+def encode_snapshot(
+    frontier: Block, child: Block, cert: QC, last_voted_round: int
+) -> bytes:
+    # The frontier (round, digest) leads the record so servers can answer
+    # probes from it without deserializing two blocks (see peek_frontier).
+    enc = Encoder()
+    enc.u8(_SNAPSHOT_VERSION)
+    enc.u64(frontier.round).raw(frontier.digest().data)
+    enc.bytes(frontier.serialize()).bytes(child.serialize())
+    cert.encode(enc)
+    enc.u64(last_voted_round)
+    return enc.finish()
+
+
+def peek_frontier(data: bytes) -> tuple[int, Digest]:
+    """Frontier (round, digest) from a snapshot record's fixed header —
+    the cheap read the Helper/probe-serving paths use."""
+    dec = Decoder(data)
+    if dec.u8() != _SNAPSHOT_VERSION:
+        raise SnapshotError("unknown snapshot version")
+    return dec.u64(), Digest(dec.raw(32))
+
+
+def decode_snapshot(data: bytes) -> Snapshot:
+    """Decode + structural validation (topology, no crypto). Raises
+    ``SnapshotError`` on any inconsistency — the record is untrusted
+    until ``verify_snapshot`` additionally checks both certificates."""
+    try:
+        dec = Decoder(data)
+        if dec.u8() != _SNAPSHOT_VERSION:
+            raise SnapshotError("unknown snapshot version")
+        frontier_round = dec.u64()
+        frontier_digest = Digest(dec.raw(32))
+        frontier = Block.deserialize(dec.bytes())
+        child = Block.deserialize(dec.bytes())
+        cert = QC.decode(dec)
+        last_voted_round = dec.u64()
+        dec.finish()
+    except (SerdeError, ValueError) as e:
+        raise SnapshotError(f"malformed snapshot record: {e}") from e
+    if frontier.round < 1:
+        raise SnapshotError("snapshot frontier at genesis")
+    if frontier.round != frontier_round or frontier.digest() != frontier_digest:
+        raise SnapshotError("snapshot header does not match frontier block")
+    if child.qc.hash != frontier.digest() or child.qc.round != frontier.round:
+        raise SnapshotError("child certificate does not certify frontier")
+    if child.round != frontier.round + 1:
+        raise SnapshotError("child is not the frontier's consecutive round")
+    if cert.hash != child.digest() or cert.round != child.round:
+        raise SnapshotError("cert does not certify child")
+    return Snapshot(frontier, child, cert, last_voted_round)
+
+
+async def verify_snapshot(snap: Snapshot, committee: Committee, cache=None) -> None:
+    """Verify the 2-chain commit proof's certificates (2×(2f+1) signatures,
+    batched off-loop through the same path QCs on the hot path use).
+    Raises ``ConsensusError`` if either certificate is invalid."""
+    for qc in (snap.child.qc, snap.cert):
+        if cache is not None:
+            await verify_off_loop(qc.verify, committee, cache, n_sigs=qc.n_votes())
+        else:
+            await verify_off_loop(qc.verify, committee, n_sigs=qc.n_votes())
+
+
+class StateSync:
+    """Anti-entropy protocol driver. Bound to a Core at ``start`` and fed
+    by its event loop (``state_request`` / ``state_response`` /
+    ``statesync_tick`` events); all scheduling goes through the Core's
+    ``_call_later`` seam, so the simulation plane drives this class on the
+    virtual clock without modification."""
+
+    def __init__(
+        self,
+        name: PublicKey,
+        committee: Committee,
+        sync_retry_delay: int,
+        active: bool = True,
+    ) -> None:
+        self.name = name
+        self.committee = committee
+        self.tick_delay_s = sync_retry_delay / 1000.0
+        #: probe loop armed (real nodes: yes; opt-in in simulation so
+        #: committed sweep seeds keep byte-identical event streams).
+        self.active = active
+        self._core = None
+        self._peers = [pk for pk, _ in committee.broadcast_addresses(name)]
+        self._next_peer = 0
+        self._last_seen_commit = -1
+        self._g_active = telemetry.gauge("statesync.active")
+        self._g_gap = telemetry.gauge("statesync.frontier_gap")
+        self._m_probes = telemetry.counter("statesync.probes_sent")
+        self._m_installed = telemetry.counter("statesync.snapshots_installed")
+
+    # -- lifecycle ----------------------------------------------------------
+
+    async def start(self, core) -> None:
+        """Called from the Core's run preamble (after ``_restore_state``):
+        restore the truncation floor from our own snapshot record, then arm
+        the probe loop."""
+        self._core = core
+        data = await core.store.read_meta(SNAPSHOT_KEY)
+        if data is not None:
+            try:
+                snap = decode_snapshot(data)
+            except SnapshotError as e:
+                # Our own record should never be malformed; a torn MetaLog
+                # tail was truncated on replay, so this is disk corruption.
+                # Run without a floor (the store may still be complete).
+                log.error("ignoring corrupt local snapshot record: %s", e)
+            else:
+                core.synchronizer.note_floor(snap.frontier)
+                # A wipe survivor restarting on a truncated store may have
+                # a consensus-state record older than the snapshot (or the
+                # commit walk would dip below the floor): adopt the floor.
+                if snap.frontier.round > core.last_committed_round:
+                    core.last_committed_round = snap.frontier.round
+                    core._last_committed_digest = snap.frontier.digest()
+                core.increase_last_voted_round(snap.last_voted_round)
+                core.update_high_qc(snap.cert)
+                if core.round <= snap.cert.round:
+                    core.round = snap.cert.round + 1
+        if self.active:
+            self._schedule_tick()
+
+    def _schedule_tick(self) -> None:
+        self._core._call_later(self.tick_delay_s, ("statesync_tick", None))
+
+    # -- probe loop (requester side) -----------------------------------------
+
+    async def handle_tick(self, _payload=None) -> None:
+        core = self._core
+        if core.last_committed_round > self._last_seen_commit:
+            # Commits progressed since the last tick: dormant. (An idle
+            # committee still advances rounds and commits empty blocks, so
+            # a healthy node never probes.)
+            self._last_seen_commit = core.last_committed_round
+            self._g_active.set(0)
+        else:
+            self._g_active.set(1)
+            self._probe()
+        self._schedule_tick()
+
+    def _probe(self) -> None:
+        """One frontier probe per tick, rotating through peers so a single
+        slow/dead peer cannot stall catch-up."""
+        if not self._peers:
+            return
+        pk = self._peers[self._next_peer % len(self._peers)]
+        self._next_peer += 1
+        address = self.committee.address(pk)
+        if address is None:
+            return
+        self._m_probes.inc()
+        log.debug("statesync probe -> %s (committed r%d)",
+                  pk, self._core.last_committed_round)
+        self._core.network.send(
+            address,
+            encode_state_request(self._core.last_committed_round, self.name),
+        )
+
+    # -- server side ---------------------------------------------------------
+
+    async def handle_state_request(self, payload) -> None:
+        since_round, origin = payload
+        core = self._core
+        address = self.committee.address(origin)
+        if address is None:
+            log.warning("state request from unknown node %s", origin)
+            return
+        digest = core._last_committed_digest
+        if digest is None:
+            return  # nothing committed yet: nothing to serve
+        snapshot = None
+        data = await core.store.read_meta(SNAPSHOT_KEY)
+        if data is not None:
+            try:
+                snap_round, _ = peek_frontier(data)
+            except SnapshotError:
+                snap_round = None
+            # Below our truncation horizon the requester can never heal by
+            # chain replay from us — attach the snapshot so it can
+            # establish a floor. (At or above the horizon the ordinary
+            # chain machinery serves everything; skip the heavy record.)
+            if snap_round is not None and since_round < snap_round:
+                snapshot = data
+        core.network.send(
+            address,
+            encode_state_response(core.last_committed_round, digest, snapshot),
+        )
+
+    # -- requester side -------------------------------------------------------
+
+    async def handle_state_response(self, payload) -> None:
+        frontier_round, frontier_digest, snapshot = payload
+        core = self._core
+        gap = frontier_round - core.last_committed_round
+        self._g_gap.set(max(0, gap))
+        if gap <= 0:
+            return  # we are at or past this peer's frontier
+        if snapshot is not None:
+            try:
+                snap = decode_snapshot(snapshot)
+            except SnapshotError as e:
+                log.warning("rejecting snapshot from peer: %s", e)
+                return
+            if snap.frontier.round > core.last_committed_round:
+                # Raises into _guarded on a byzantine proof — NOTHING is
+                # adopted before both certificates verify.
+                await verify_snapshot(snap, self.committee, core._cert_cache)
+                await self._install(snap, snapshot)
+        # Pull the suffix between our (possibly just-raised) frontier and
+        # the peer's through the normal sync machinery: the helper answers
+        # with ancestor chains, and the suspend/unwind walk heals up to
+        # the live window, where ordinary proposals take over.
+        if frontier_round > core.last_committed_round:
+            self._request_frontier(frontier_digest)
+
+    def _request_frontier(self, digest: Digest) -> None:
+        pk = self._peers[self._next_peer % len(self._peers)] if self._peers else None
+        address = self.committee.address(pk) if pk is not None else None
+        self._core.synchronizer.request_block(digest, address)
+
+    async def _install(self, snap: Snapshot, raw: bytes) -> None:
+        """Adopt a VERIFIED snapshot: persist the floor record first
+        (fsync — a crash right after must restart knowing the floor), then
+        materialize F and c1 so suspended chain walks unwind onto them."""
+        core = self._core
+        log.info(
+            "installing snapshot: frontier r%d (was r%d)",
+            snap.frontier.round,
+            core.last_committed_round,
+        )
+        self._m_installed.inc()
+        await core.store.write_meta(SNAPSHOT_KEY, raw, sync=True)
+        core.synchronizer.note_floor(snap.frontier)
+        core.last_committed_round = max(
+            core.last_committed_round, snap.frontier.round
+        )
+        core._last_committed_digest = snap.frontier.digest()
+        # Never vote at or below the adopted window: the creator's hint
+        # covers rounds where OUR pre-wipe votes may live on.
+        core.increase_last_voted_round(
+            max(snap.last_voted_round, snap.child.round)
+        )
+        await core.process_qc(snap.cert)  # adopt high_qc, enter cert.round+1
+        await core._persist_state()
+        # Writing F releases notify_read waiters of blocks suspended on it
+        # — do it AFTER the consensus state above is consistent.
+        await core.store.write(snap.frontier.digest().data, snap.frontier.serialize())
+        await core.store.write(snap.child.digest().data, snap.child.serialize())
+        core.synchronizer.cache_block(snap.frontier)
+        core.synchronizer.cache_block(snap.child)
+
+
+class Compactor:
+    """Snapshot + truncate driver. ``note_commit`` tracks the commit head;
+    ``maybe_compact`` fires once the head is ``2 × retention_rounds`` past
+    the previous snapshot (hysteresis: each rewrite costs a full log copy,
+    so truncate in retention-sized steps, not per round)."""
+
+    def __init__(self, store, retention_rounds: int) -> None:
+        self.store = store
+        self.retention = retention_rounds
+        self._snapshot_round = 0
+        self._head: Block | None = None
+        self._m_compactions = telemetry.counter("store.compactions")
+        self._m_freed = telemetry.counter("store.compaction_bytes_freed")
+        self._g_snapshot_round = telemetry.gauge("store.snapshot_round")
+
+    def note_commit(self, block: Block) -> None:
+        if self._head is None or block.round > self._head.round:
+            self._head = block
+
+    async def _read_parent(self, block: Block) -> Block | None:
+        if block.qc == QC.genesis():
+            return None
+        data = await self.store.read(block.parent().data)
+        if data is None:
+            return None  # previous truncation floor (or genesis)
+        return Block.deserialize(data)
+
+    async def maybe_compact(self, core) -> None:
+        if self.retention <= 0 or self._head is None:
+            return
+        if core.last_committed_round - self._snapshot_round < 2 * self.retention:
+            return
+        target = core.last_committed_round - self.retention
+        # Walk the committed chain head -> tail for the newest proof pair
+        # (F, c1) with consecutive rounds at or below the target. `above`
+        # is c1's chain child: its qc is the certificate committing c1.
+        above: Block | None = None
+        child = self._head
+        parent = await self._read_parent(child)
+        while parent is not None:
+            if (
+                parent.round <= target
+                and above is not None
+                and child.round == parent.round + 1
+            ):
+                break
+            above, child, parent = child, parent, await self._read_parent(parent)
+        else:
+            return  # no consecutive-round pair in reach — retry next commit
+        frontier, c1, cert = parent, child, above.qc
+        snapshot = encode_snapshot(frontier, c1, cert, core.last_voted_round)
+        # Floor record FIRST, durably: a crash between this write and the
+        # log rewrite restarts with the floor known and the old log whole.
+        await self.store.write_meta(SNAPSHOT_KEY, snapshot, sync=True)
+        # Drop set: every block strictly below F back to the previous
+        # floor, plus their payload batch keys (committed long ago; peers
+        # below the horizon catch up by snapshot, not batch replay).
+        drop: list[bytes] = []
+        cur = await self._read_parent(frontier)
+        while cur is not None:
+            drop.append(cur.digest().data)
+            for d in cur.payload:
+                drop.append(d.data)
+            cur = await self._read_parent(cur)
+        freed = await self.store.compact(drop)
+        self._snapshot_round = frontier.round
+        core.synchronizer.note_floor(frontier)
+        self._m_compactions.inc()
+        self._m_freed.inc(freed)
+        self._g_snapshot_round.set(frontier.round)
+        log.info(
+            "snapshot at r%d: dropped %d keys below the floor, freed %d bytes",
+            frontier.round,
+            len(drop),
+            freed,
+        )

@@ -54,6 +54,11 @@ class Synchronizer:
         # poll tick once expired (the committee-wide duplicate storm).
         self._last_sent: dict[Digest, float] = {}
         self._ancestor_cache: dict[bytes, Block] = {}  # digest -> Block
+        # Truncation floor (Lazarus): digest of the snapshot frontier
+        # block F. Below it the chain is truncated everywhere — walks
+        # stop at F instead of suspending on an unservable parent.
+        self._floor: Digest | None = None
+        self._floor_round = 0
         self._tasks: set[asyncio.Task] = set()
         self._main = asyncio.create_task(self._run(), name="consensus_synchronizer")
 
@@ -152,6 +157,53 @@ class Synchronizer:
             self._last_sent[digest] = now
         return retries
 
+    def note_floor(self, frontier: Block) -> None:
+        """Adopt ``frontier`` as the truncation floor (restored from our
+        own snapshot record, set by the compactor, or installed from a
+        verified peer snapshot). Any outstanding request for its truncated
+        parent can never be served — cancel it, and release ``frontier``
+        itself from pending (the installer just materialized it)."""
+        self._floor = frontier.digest()
+        self._floor_round = frontier.round
+        parent = frontier.parent()
+        self._requests.pop(parent, None)
+        self._last_sent.pop(parent, None)
+        self._pending.discard(frontier.digest())
+        # Cached ancestors strictly below the floor may no longer be in
+        # the store — drop them so cache and store agree on what a walk
+        # can reach (a cached block whose stored parent was truncated
+        # would otherwise suspend on an unservable digest).
+        for key in [
+            k
+            for k, b in self._ancestor_cache.items()
+            if b.round < frontier.round
+        ]:
+            del self._ancestor_cache[key]
+
+    def request_block(self, digest: Digest, address) -> None:
+        """Directly solicit ``digest`` from the peer at ``address`` (the
+        state-sync frontier pull). Registers it as requested — so the
+        lenient-leader solicited-block rule admits the reply chain and the
+        retry timer re-broadcasts on loss — and self-cleans once the block
+        lands in the store."""
+        if digest in self._requests:
+            return
+        log.debug("requesting state-sync frontier block %s", digest)
+        telemetry.counter("consensus.sync_requests").inc()
+        now = self._clock()
+        self._requests[digest] = now
+        self._last_sent[digest] = now
+        if address is not None:
+            self.network.send(address, encode_sync_request(digest, self.name))
+        task = asyncio.create_task(self._request_waiter(digest))
+        self._tasks.add(task)
+        task.add_done_callback(self._tasks.discard)
+
+    async def _request_waiter(self, digest: Digest) -> None:
+        await self.store.notify_read(digest.data)
+        self._requests.pop(digest, None)
+        self._last_sent.pop(digest, None)
+
     def is_pending(self, digest: Digest) -> bool:
         """True if ``digest`` is a block already suspended awaiting its
         ancestors (chain-reply redeliveries skip re-verification)."""
@@ -186,6 +238,22 @@ class Synchronizer:
         """The parent if stored; None after scheduling a sync (reference
         ``synchronizer.rs:120-134``)."""
         if block.qc == QC.genesis():
+            return Block.genesis()
+        if self._floor is not None and block.digest() == self._floor:
+            # ``block`` IS the truncation frontier: its ancestry is
+            # truncated (here and at every peer past the horizon). Serve a
+            # genesis placeholder — round 0 can never satisfy the 2-chain
+            # commit rule, and the commit walk stops at
+            # last_committed_round (>= the floor round) before reaching
+            # it, so the placeholder is never committed.
+            return Block.genesis()
+        if self._floor_round and block.round <= self._floor_round:
+            # Stale delivery at or below the horizon (a reordered or
+            # byzantine replay of a long-committed round, or a fork
+            # abandoned before the floor): its ancestry is truncated at
+            # every honest peer, so suspending would park a request no
+            # one can serve. Same placeholder argument as above — a
+            # round this old can neither commit nor earn a vote.
             return Block.genesis()
         parent_digest = block.parent().data
         cached = self._ancestor_cache.get(parent_digest)

@@ -26,7 +26,13 @@ from __future__ import annotations
 
 from .policy import Schedule
 
-__all__ = ["CommitRecord", "check", "check_availability", "VERDICT_SCHEMA"]
+__all__ = [
+    "CommitRecord",
+    "check",
+    "check_availability",
+    "check_frontier_availability",
+    "VERDICT_SCHEMA",
+]
 
 VERDICT_SCHEMA = "faultline-verdict-v1"
 
@@ -88,6 +94,74 @@ def check_availability(
         "f": f,
         "required_holders": required,
         "checked": len(committed),
+        "violations": violations,
+    }
+
+
+def check_frontier_availability(
+    schedule: Schedule,
+    committed: set,
+    resolvers: dict,
+    floors: dict,
+    *,
+    honest: set[str] | None = None,
+) -> dict:
+    """The Lazarus truncation invariant: log compaction must never make
+    a committed block unservable to a catching-up replica. After the
+    run, every committed ``(round, digest)`` must be SERVABLE by at
+    least f+1 honest nodes, where node X serves it iff
+
+    - X's store still resolves ``digest`` (the block survives below or
+      above X's truncation horizon), or
+    - X's snapshot frontier round >= ``round`` (X truncated it, but its
+      snapshot subsumes the block's state — a joiner syncing from X
+      lands at or past the block and never needs it individually).
+
+    ``committed`` is a set of ``(round, digest)`` pairs (digest in any
+    hashable form); ``resolvers`` maps each digest to the set of node
+    names whose store resolves it; ``floors`` maps node name to its
+    snapshot frontier round (0/absent when the node never compacted).
+    Returns a plain-data verdict section harnesses merge into their run
+    verdicts.
+    """
+    byzantine = {
+        e.params["node"] for e in schedule.events if e.kind == "byzantine"
+    }
+    if honest is None:
+        honest = set(schedule.nodes) - byzantine
+    n = len(schedule.nodes)
+    f = (n - 1) // 3
+    required = f + 1
+    violations = []
+    for round_, digest in sorted(
+        committed, key=lambda rd: (rd[0], str(rd[1]))
+    ):
+        servers = sorted(
+            node
+            for node in honest
+            if node in resolvers.get(digest, ())
+            or floors.get(node, 0) >= round_
+        )
+        if len(servers) < required:
+            violations.append(
+                {
+                    "type": "unservable_commit",
+                    "round": round_,
+                    "digest": (
+                        digest.hex()
+                        if isinstance(digest, (bytes, bytearray))
+                        else str(digest)
+                    ),
+                    "honest_servers": servers,
+                    "required": required,
+                }
+            )
+    return {
+        "ok": not violations,
+        "f": f,
+        "required_servers": required,
+        "checked": len(committed),
+        "floors": {k: floors[k] for k in sorted(floors)},
         "violations": violations,
     }
 

@@ -77,6 +77,7 @@ class ScenarioRun:
         leader_elector: str = "",
         min_recovery_commits: int = 3,
         recovery_timeout_s: float = 30.0,
+        retention_rounds: int = 0,
         clock=time.monotonic,
     ) -> None:
         from hotstuff_tpu.consensus import Authority, Committee, Parameters
@@ -110,6 +111,7 @@ class ScenarioRun:
             timeout_delay=timeout_delay,
             batch_vote_verification=True,
             leader_elector=leader_elector,
+            retention_rounds=retention_rounds,
         )
         from hotstuff_tpu.store import Store
 
@@ -202,12 +204,21 @@ class ScenarioRun:
         telemetry.counter("faultline.injected.crashes").inc()
         log.info("faultline crashed %s", eng.name)
 
-    async def _restart_engine(self, eng: _Engine) -> None:
+    async def _restart_engine(self, eng: _Engine, wipe: bool = False) -> None:
         if not eng.crashed:
             return
+        if wipe:
+            # Cold rejoin (Lazarus): the node's disk is lost — replace
+            # the store with a fresh empty one; the engine must recover
+            # via state sync from its peers.
+            from hotstuff_tpu.store import Store
+
+            eng.store = Store()
         await self._spawn_engine(eng)
         telemetry.counter("faultline.injected.restarts").inc()
-        log.info("faultline restarted %s", eng.name)
+        log.info(
+            "faultline restarted %s%s", eng.name, " (wiped)" if wipe else ""
+        )
 
     # -- byzantine actors ----------------------------------------------------
 
@@ -225,7 +236,7 @@ class ScenarioRun:
         if action["action"] == "crash":
             await self._crash_engine(eng)
         elif action["action"] == "restart":
-            await self._restart_engine(eng)
+            await self._restart_engine(eng, wipe=action.get("wipe", False))
         elif action["action"] == "byzantine_on":
             key = (node, action["behavior"])
             if key not in self.actors:
@@ -246,6 +257,39 @@ class ScenarioRun:
             actor = self.actors.pop((node, action["behavior"]), None)
             if actor is not None:
                 await actor.shutdown()
+
+    # -- lazarus frontier probe ----------------------------------------------
+
+    async def _probe_frontier_availability(self) -> dict:
+        """Post-run audit for retention-armed runs: every committed
+        block must still be servable (block bytes or subsuming snapshot)
+        at f+1 honest live stores — truncation may bound disk, never
+        availability."""
+        from hotstuff_tpu.consensus.statesync import (
+            SNAPSHOT_KEY,
+            peek_frontier,
+        )
+
+        from .checker import check_frontier_availability
+
+        committed: set = set()
+        for recs in self.commits.values():
+            for rec in recs:
+                committed.add((rec.round, rec.digest))
+        resolvers: dict = {}
+        floors: dict[str, int] = {}
+        for eng in self.engines:
+            if eng.crashed:
+                continue
+            snap = await eng.store.read_meta(SNAPSHOT_KEY)
+            if snap is not None:
+                floors[eng.name] = peek_frontier(snap)[0]
+            for _round, digest in committed:
+                if await eng.store.read(digest) is not None:
+                    resolvers.setdefault(digest, set()).add(eng.name)
+        return check_frontier_availability(
+            self.schedule, committed, resolvers, floors
+        )
 
     # -- main drive ----------------------------------------------------------
 
@@ -316,8 +360,16 @@ class ScenarioRun:
             min_recovery_commits=self.min_recovery_commits,
             injections=self.plane.injection_summary(),
         )
+        if self.params.retention_rounds > 0:
+            verdict["frontier_availability"] = (
+                await self._probe_frontier_availability()
+            )
         flight_path = None
-        if not (verdict["safety"]["ok"] and verdict["liveness"]["recovered"]):
+        if not (
+            verdict["safety"]["ok"]
+            and verdict["liveness"]["recovered"]
+            and verdict.get("frontier_availability", {"ok": True})["ok"]
+        ):
             # Checker failure => actionable postmortem, not just a
             # verdict: dump the flight recorder (the last ring of
             # protocol trace events across every in-process engine, plus

@@ -244,6 +244,12 @@ class Scenario:
             until = None if until is None else float(until)
             if kind in ("crash", "restart"):
                 params = {"node": self._resolve_node(ev.get("node"), nodes, rng)}
+                if kind == "restart" and ev.get("wipe"):
+                    # Cold rejoin (Lazarus): the node restarts with an
+                    # EMPTY store and must recover via state sync. A
+                    # plain boolean rider — no RNG draw — so committed
+                    # scenarios keep byte-identical schedules.
+                    params["wipe"] = True
             elif kind == "partition":
                 groups = ev.get("groups")
                 if groups is None:

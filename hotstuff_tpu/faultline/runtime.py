@@ -199,9 +199,10 @@ class FaultPlane:
                      "node": node, "behavior": behavior}
                 )
         elif kind in ("crash", "restart"):
-            self._pending_actions.append(
-                {"action": kind, "node": ev.params["node"]}
-            )
+            action = {"action": kind, "node": ev.params["node"]}
+            if ev.params.get("wipe"):
+                action["wipe"] = True  # cold rejoin: restart on empty store
+            self._pending_actions.append(action)
         self._g_active.set(
             len(self._partitions) + len(self._links)
             + sum(len(b) for b in self._behaviors.values())

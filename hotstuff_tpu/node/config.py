@@ -207,6 +207,7 @@ class Parameters:
                     # Emit-side wire negotiation: decode always accepts
                     # both formats, so this is safe to flip per epoch.
                     wire_v2=bool(c.get("wire_v2", True)),
+                    retention_rounds=int(c.get("retention_rounds", 0)),
                 ),
                 MempoolParameters(
                     gc_depth=int(m.get("gc_depth", 50)),
@@ -239,6 +240,7 @@ class Parameters:
                 ),
                 "leader_elector": self.consensus.leader_elector,
                 "wire_v2": self.consensus.wire_v2,
+                "retention_rounds": self.consensus.retention_rounds,
             },
             "mempool": {
                 "gc_depth": self.mempool.gc_depth,

@@ -50,7 +50,15 @@ from hotstuff_tpu.consensus.messages import (
     Block,
     SeatTable,
     encode_propose,
+    encode_state_response,
     encode_sync_request,
+)
+from hotstuff_tpu.consensus.statesync import (
+    SNAPSHOT_KEY,
+    Compactor,
+    SnapshotError,
+    StateSync,
+    peek_frontier,
 )
 from hotstuff_tpu.consensus.proposer import Cleanup as ProposerCleanup
 from hotstuff_tpu.consensus.proposer import Make as ProposerMake
@@ -199,6 +207,8 @@ class SimSynchronizer:
         self._requests = {}  # parent Digest -> first-request virtual ts
         self._waiting: dict[bytes, list[Block]] = {}  # parent bytes -> blocks
         self._ancestor_cache: dict[bytes, Block] = {}
+        self._floor = None  # truncation floor digest (Lazarus)
+        self._floor_round = 0
 
     # -- Core-facing interface (mirrors consensus.Synchronizer) ----------
 
@@ -213,8 +223,50 @@ class SimSynchronizer:
             self._ancestor_cache.clear()
         self._ancestor_cache[block.digest().data] = block
 
+    def note_floor(self, frontier: Block) -> None:
+        """Mirror of ``Synchronizer.note_floor``: adopt the truncation
+        floor and cancel any suspend/request aimed below it."""
+        self._floor = frontier.digest()
+        self._floor_round = frontier.round
+        parent = frontier.parent()
+        self._requests.pop(parent, None)
+        self._waiting.pop(parent.data, None)
+        self._pending.discard(frontier.digest())
+        # Drop cached ancestors below the floor: compaction may have
+        # truncated their stored parents (see consensus/synchronizer.py).
+        for key in [
+            k
+            for k, b in self._ancestor_cache.items()
+            if b.round < frontier.round
+        ]:
+            del self._ancestor_cache[key]
+
+    def request_block(self, digest, address) -> None:
+        """Mirror of ``Synchronizer.request_block`` (the state-sync
+        frontier pull): solicited registration + retry tick; fulfillment
+        is cleared by ``on_store_write``."""
+        if digest in self._requests:
+            return
+        telemetry.counter("consensus.sync_requests").inc()
+        self._requests[digest] = self._clock()
+        if address is not None:
+            self._effects.append(
+                ("send", address, encode_sync_request(digest, self.name))
+            )
+        self._effects.append(
+            ("sched", self.sync_retry_delay, ("sync_retry", digest))
+        )
+
     async def get_parent_block(self, block: Block):
         if block.qc == QC.genesis():
+            return Block.genesis()
+        if self._floor is not None and block.digest() == self._floor:
+            # Truncation frontier: ancestry is truncated everywhere (see
+            # consensus/synchronizer.py for the safety argument).
+            return Block.genesis()
+        if self._floor_round and block.round <= self._floor_round:
+            # Stale delivery at or below the horizon — unservable
+            # ancestry, placeholder (see consensus/synchronizer.py).
             return Block.genesis()
         parent_digest = block.parent().data
         cached = self._ancestor_cache.get(parent_digest)
@@ -264,12 +316,13 @@ class SimSynchronizer:
 
     def on_store_write(self, key: bytes) -> None:
         blocks = self._waiting.pop(key, None)
-        if not blocks:
-            return
-        for block in blocks:
-            self._pending.discard(block.digest())
-            self._effects.append(("sched", 0.0, ("loopback", block)))
-        # The request (keyed by Digest) is fulfilled.
+        if blocks:
+            for block in blocks:
+                self._pending.discard(block.digest())
+                self._effects.append(("sched", 0.0, ("loopback", block)))
+        # The request (keyed by Digest) is fulfilled. Direct state-sync
+        # frontier requests have no suspended waiter, so this runs even
+        # when nothing was waiting.
         for parent in list(self._requests):
             if parent.data == key:
                 del self._requests[parent]
@@ -317,6 +370,8 @@ class CoreStateMachine:
         batch_vote_verification: bool = True,
         wire_v2: bool = True,
         store: _NotifyingStore | None = None,
+        retention_rounds: int = 0,
+        statesync_active: bool = False,
     ) -> None:
         self.clock = clock
         self.store = store if store is not None else _NotifyingStore()
@@ -369,6 +424,22 @@ class CoreStateMachine:
             wire_seats=wire_seats,
             network=self.outbox,
             timer=Timer(timeout_delay, clock=clock),
+            # Lazarus parity: every sim node answers state probes and can
+            # install verified snapshots; the ACTIVE probe loop is opt-in
+            # (statesync_active) so committed sweep seeds keep their
+            # byte-identical event streams; the compactor arms with a
+            # retention depth exactly as on the real plane.
+            statesync=StateSync(
+                name,
+                committee,
+                sync_retry_delay,
+                active=statesync_active,
+            ),
+            compactor=(
+                Compactor(self.store, retention_rounds)
+                if retention_rounds > 0
+                else None
+            ),
         )
         self.core.sim_effects = self._effects
         self._handlers = self.core.bound_handlers()
@@ -391,6 +462,9 @@ class CoreStateMachine:
         round."""
         self.clock.advance_to(now)
         run_sync(self.core._restore_state())
+        # Same preamble order as Core.run(): floor restoration + probe
+        # arming between state restore and the timer.
+        run_sync(self.core._statesync.start(self.core))
         self.core.timer.reset()
         if self.core.name == self.core.leader_elector.get_leader(self.core.round):
             run_sync(self.core.generate_proposal(None))
@@ -471,6 +545,20 @@ class CoreStateMachine:
         try:
             data = run_sync(self.store.read(digest.data))
             if data is None:
+                # Truncated-or-unknown digest: answer with the snapshot
+                # record so the requester can establish a floor (mirror
+                # of the real Helper's NACK path).
+                snap = run_sync(self.store.read_meta(SNAPSHOT_KEY))
+                if snap is not None:
+                    try:
+                        round_, frontier = peek_frontier(snap)
+                    except SnapshotError as e:
+                        log.error("corrupt snapshot record: %s", e)
+                    else:
+                        self.outbox.send(
+                            address,
+                            encode_state_response(round_, frontier, snap),
+                        )
                 return
             block = Block.deserialize(data)
             self.outbox.send(address, encode_propose(block))

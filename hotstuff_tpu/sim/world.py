@@ -213,6 +213,11 @@ class SimWorld:
         twins: dict[str, str] | None = None,
         base_port: int = 47000,
         verify_memo: bool = True,
+        # Lazarus: snapshot/truncate retention depth (0 = no compaction)
+        # and the anti-entropy probe loop (opt-in: committed sweep seeds
+        # keep byte-identical event streams with it off).
+        retention_rounds: int = 0,
+        statesync_active: bool = False,
     ) -> None:
         self.scenario = scenario
         self.n = n
@@ -226,6 +231,8 @@ class SimWorld:
             sync_retry_delay=sync_retry_delay,
             leader_elector=leader_elector,
             batch_vote_verification=batch_vote_verification,
+            retention_rounds=retention_rounds,
+            statesync_active=statesync_active,
         )
 
         base_names = [_node_name(i) for i in range(n)]
@@ -407,12 +414,21 @@ class SimWorld:
         slot.incarnation += 1  # drops every in-flight frame/event/timer
         log.info("sim crashed %s at v=%.3f", slot.name, self.plane.vnow())
 
-    def _restart(self, slot: _Slot) -> None:
+    def _restart(self, slot: _Slot, wipe: bool = False) -> None:
         if not slot.crashed:
             return
         slot.incarnation += 1
+        if wipe:
+            # Cold rejoin: the node's "disk" is lost — the next spawn
+            # starts on an empty store and must recover via state sync.
+            slot.engine = None
         self._spawn(slot)
-        log.info("sim restarted %s at v=%.3f", slot.name, self.plane.vnow())
+        log.info(
+            "sim restarted %s%s at v=%.3f",
+            slot.name,
+            " (wiped)" if wipe else "",
+            self.plane.vnow(),
+        )
 
     def _enact(self, action: dict) -> None:
         node = action["node"]
@@ -423,7 +439,7 @@ class SimWorld:
         if kind == "crash":
             self._crash(slot)
         elif kind == "restart":
-            self._restart(slot)
+            self._restart(slot, wipe=action.get("wipe", False))
         elif kind == "byzantine_on":
             key = (node, action["behavior"])
             if key not in self._byz and action["behavior"] != "silent_leader":

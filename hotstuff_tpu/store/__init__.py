@@ -53,6 +53,15 @@ class MetaLog:
         self._path = os.path.join(dir_path, "meta.log")
         self._meta: dict[bytes, bytes] = {}
         self._records = 0
+        # A crash between the compaction tmp write and its os.replace
+        # leaves a stale ``meta.log.tmp`` beside the (intact) live log.
+        # It must be discarded on open: a LATER compaction would reuse
+        # the name, and a crash inside ITS write window could then
+        # surface a file mixing two generations of records.
+        try:
+            os.unlink(self._path + ".tmp")
+        except OSError:
+            pass
         self._replay()
         existed = os.path.exists(self._path)
         self._f = open(self._path, "ab")
@@ -162,6 +171,10 @@ class LogEngine:
         self._path = path
         os.makedirs(path, exist_ok=True)
         self._log_path = os.path.join(path, "store.log")
+        try:  # stale compaction temp from a crash inside the replace window
+            os.unlink(self._log_path + ".tmp")
+        except OSError:
+            pass
         self._replay()
         self._log = open(self._log_path, "ab")
         self._metalog = MetaLog(path)
@@ -200,6 +213,43 @@ class LogEngine:
     def get(self, key: bytes) -> bytes | None:
         return self._index.get(key)
 
+    def compact(self, drop_keys) -> int:
+        """Rewrite ``store.log`` without ``drop_keys`` (and without superseded
+        duplicate records), atomically: tmp + fsync + ``os.replace`` +
+        directory fsync, same crash discipline as ``MetaLog._compact``. A
+        crash at any point leaves either the old complete log or the new
+        complete log. Unknown keys are retained conservatively. Returns the
+        number of bytes reclaimed (0 if the rewrite grew the file, which
+        cannot happen in practice since dropped + superseded records only
+        shrink it)."""
+        drop = set(drop_keys)
+        tmp = self._log_path + ".tmp"
+        before = os.path.getsize(self._log_path) if os.path.exists(self._log_path) else 0
+        with open(tmp, "wb") as f:
+            for k, v in self._index.items():
+                if k in drop:
+                    continue
+                f.write(_HDR.pack(len(k), len(v)) + k + v)
+            f.flush()
+            os.fsync(f.fileno())
+        self._log.close()
+        os.replace(tmp, self._log_path)
+        self._fsync_dir()
+        self._log = open(self._log_path, "ab")
+        for k in drop:
+            self._index.pop(k, None)
+        after = os.path.getsize(self._log_path)
+        return max(0, before - after)
+
+    def _fsync_dir(self) -> None:
+        self._metalog._fsync_dir()
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(self._log_path)
+        except OSError:
+            return 0
+
     def close(self) -> None:
         self._log.close()
         self._metalog.close()
@@ -223,6 +273,14 @@ class MemEngine:
 
     def get_meta(self, key: bytes) -> bytes | None:
         return self._meta.get(key)
+
+    def compact(self, drop_keys) -> int:
+        freed = 0
+        for k in drop_keys:
+            v = self._index.pop(k, None)
+            if v is not None:
+                freed += len(k) + len(v) + _HDR.size
+        return freed
 
     def close(self) -> None:
         pass
@@ -266,6 +324,15 @@ class Store:
 
     async def read_meta(self, key: bytes) -> bytes | None:
         return self._engine.get_meta(key)
+
+    async def compact(self, drop_keys) -> int:
+        """Drop ``drop_keys`` from the data log and reclaim their space
+        (engines without compaction support — e.g. the native engine — are a
+        no-op). Returns bytes reclaimed."""
+        engine_compact = getattr(self._engine, "compact", None)
+        if engine_compact is None:
+            return 0
+        return engine_compact(drop_keys)
 
     async def notify_read(self, key: bytes) -> bytes:
         """Return the value for ``key``, waiting for a future ``write`` if it

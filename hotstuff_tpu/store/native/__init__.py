@@ -67,6 +67,12 @@ def _load():
         ]
         lib.hs_store_size.restype = ctypes.c_uint64
         lib.hs_store_size.argtypes = [ctypes.c_void_p]
+        lib.hs_store_compact.restype = ctypes.c_int64
+        lib.hs_store_compact.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
         lib.hs_store_close.restype = None
         lib.hs_store_close.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -102,6 +108,26 @@ class NativeEngine:
         if rc != 0:
             raise OSError("native store read failed")
         return buf.raw
+
+    def compact(self, drop_keys) -> int:
+        """Drop ``drop_keys`` from the log and reclaim their space (atomic
+        rewrite, same crash discipline as ``LogEngine.compact``). Returns
+        bytes reclaimed."""
+        import struct
+
+        blob = b"".join(
+            struct.pack("<I", len(k)) + bytes(k) for k in drop_keys
+        )
+        freed = self._lib.hs_store_compact(self._handle, blob, len(blob))
+        if freed < 0:
+            raise OSError("native store compaction failed")
+        return int(freed)
+
+    def size_bytes(self) -> int:
+        try:
+            return os.path.getsize(os.path.join(self._path, "store.log"))
+        except OSError:
+            return 0
 
     # Meta records: the same shared MetaLog append file as the Python
     # engine (with fallback reads of the legacy per-key replace files).

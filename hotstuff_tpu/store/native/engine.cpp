@@ -18,17 +18,38 @@
 #include <cstring>
 #include <string>
 #include <unordered_map>
+#include <unordered_set>
 #include <vector>
 
-#include <unistd.h>  // truncate
+#include <fcntl.h>     // open (directory fsync)
+#include <sys/stat.h>  // stat
+#include <unistd.h>    // truncate, fsync, close
 
 extern "C" {
 
 struct HsStore {
     std::unordered_map<std::string, std::string> index;
     FILE* log = nullptr;
+    std::string path;
     std::string error;
 };
+
+static int64_t file_bytes(const std::string& path) {
+    struct stat st;
+    if (::stat(path.c_str(), &st) != 0) return 0;
+    return static_cast<int64_t>(st.st_size);
+}
+
+// Best-effort directory fsync, same discipline as the Python engine's
+// MetaLog._fsync_dir: without it a rename can be lost on power failure.
+static void fsync_dir(const std::string& file_path) {
+    auto slash = file_path.find_last_of('/');
+    std::string dir = slash == std::string::npos ? "." : file_path.substr(0, slash);
+    int fd = ::open(dir.c_str(), O_RDONLY);
+    if (fd < 0) return;
+    ::fsync(fd);  // unsupported on some filesystems: best effort
+    ::close(fd);
+}
 
 static bool replay(HsStore* s, const std::string& path) {
     FILE* f = std::fopen(path.c_str(), "rb");
@@ -68,6 +89,11 @@ static bool replay(HsStore* s, const std::string& path) {
 
 HsStore* hs_store_open(const char* log_path) {
     auto* s = new HsStore();
+    s->path = log_path;
+    // A crash between the compaction tmp write and its rename leaves a
+    // stale ``store.log.tmp`` beside the (intact) live log; discard it so
+    // a later compaction cannot surface a file mixing two generations.
+    std::remove((s->path + ".tmp").c_str());
     if (!replay(s, log_path)) {
         delete s;
         return nullptr;
@@ -111,6 +137,63 @@ int hs_store_read(HsStore* s, const uint8_t* key, uint32_t klen, uint8_t* out,
 }
 
 uint64_t hs_store_size(HsStore* s) { return s->index.size(); }
+
+// Rewrite the log without the dropped keys (and without superseded
+// duplicate records), atomically: tmp + fsync + rename + directory fsync —
+// the same crash discipline as the Python LogEngine.compact. A crash at
+// any point leaves either the old complete log or the new complete log.
+// ``blob`` packs the drop set as repeated (u32 klen, key) entries.
+// Returns bytes reclaimed, or -1 on error (the old log stays live).
+int64_t hs_store_compact(HsStore* s, const uint8_t* blob, uint64_t blob_len) {
+    std::unordered_set<std::string> drop;
+    uint64_t pos = 0;
+    while (pos + 4 <= blob_len) {
+        uint32_t klen;
+        std::memcpy(&klen, blob + pos, 4);
+        pos += 4;
+        if (pos + klen > blob_len) return -1;  // malformed drop set
+        drop.emplace(reinterpret_cast<const char*>(blob + pos), klen);
+        pos += klen;
+    }
+    if (pos != blob_len) return -1;
+    const std::string tmp = s->path + ".tmp";
+    FILE* f = std::fopen(tmp.c_str(), "wb");
+    if (!f) return -1;
+    for (const auto& kv : s->index) {
+        if (drop.count(kv.first)) continue;
+        uint32_t hdr[2] = {static_cast<uint32_t>(kv.first.size()),
+                           static_cast<uint32_t>(kv.second.size())};
+        if (std::fwrite(hdr, 1, sizeof hdr, f) != sizeof hdr ||
+            std::fwrite(kv.first.data(), 1, kv.first.size(), f) !=
+                kv.first.size() ||
+            std::fwrite(kv.second.data(), 1, kv.second.size(), f) !=
+                kv.second.size()) {
+            std::fclose(f);
+            std::remove(tmp.c_str());
+            return -1;
+        }
+    }
+    if (std::fflush(f) != 0 || ::fsync(fileno(f)) != 0) {
+        std::fclose(f);
+        std::remove(tmp.c_str());
+        return -1;
+    }
+    std::fclose(f);
+    const int64_t before = file_bytes(s->path);
+    std::fclose(s->log);
+    s->log = nullptr;
+    if (std::rename(tmp.c_str(), s->path.c_str()) != 0) {
+        std::remove(tmp.c_str());
+        s->log = std::fopen(s->path.c_str(), "ab");
+        return -1;
+    }
+    fsync_dir(s->path);
+    s->log = std::fopen(s->path.c_str(), "ab");
+    if (!s->log) return -1;
+    for (const auto& k : drop) s->index.erase(k);
+    const int64_t after = file_bytes(s->path);
+    return before > after ? before - after : 0;
+}
 
 void hs_store_close(HsStore* s) {
     if (s->log) std::fclose(s->log);

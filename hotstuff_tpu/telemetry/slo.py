@@ -197,6 +197,7 @@ def memory_slos(
     *,
     rss_growth_bytes_per_s: float = 8 * 1024 * 1024,
     store_growth_bytes_per_s: float = 32 * 1024 * 1024,
+    store_bytes_max: float | None = None,
     allow_violation_fraction: float = 0.0,
 ) -> list[SloSpec]:
     """The memory-growth gate (ROADMAP item 4's unbounded-growth failure
@@ -206,8 +207,15 @@ def memory_slos(
     them (resource collector not installed) skip these specs. Store
     growth is workload-proportional — the default bound is a ceiling on
     runaway WAL/MetaLog growth, not a tight fit; soaks tune it to their
-    input rate."""
-    return [
+    input rate.
+
+    ``store_bytes_max`` (None = off) adds an ABSOLUTE cap on on-disk
+    store size — the gate retention-armed soaks use: with
+    snapshot/truncate compaction live, store size must plateau at the
+    retention depth's working set, so a cap is meaningful regardless of
+    run length. Without compaction store size is unbounded by design and
+    only the growth-rate bound applies."""
+    specs = [
         SloSpec(
             "rss_growth_bytes_per_s", "gauge_growth",
             "resource.rss_bytes", max=rss_growth_bytes_per_s,
@@ -219,6 +227,15 @@ def memory_slos(
             allow_violation_fraction=allow_violation_fraction,
         ),
     ]
+    if store_bytes_max is not None:
+        specs.append(
+            SloSpec(
+                "store_bytes_max", "gauge_max",
+                "resource.store_bytes", max=store_bytes_max,
+                allow_violation_fraction=allow_violation_fraction,
+            )
+        )
+    return specs
 
 
 # -- window arithmetic -------------------------------------------------------

@@ -58,6 +58,13 @@ Detector catalog (all tunable via :class:`WatchtowerConfig`):
 - ``slope_breach``: per-node RSS / store-size growth rate over a
   sliding window exceeds the bound — the same ``gauge_growth``
   semantics as :mod:`hotstuff_tpu.telemetry.slo`, evaluated online.
+- ``sync_stall``: a peer whose state-sync probe loop stays active
+  (``statesync.active``) with a frontier gap ≥ ``sync_stall_min_gap``
+  that is NOT closing for ``sync_stall_budget_s`` — a rejoining
+  replica stuck behind the quorum (peers refusing to serve it, a
+  snapshot it keeps rejecting, or a truncation horizon nobody can
+  bridge). A closing gap re-anchors the budget: slow-but-progressing
+  catch-up never fires.
 - ``equivocation``: conflicting-vote or conflicting-proposal evidence
   — the same (author, round) seen with two different digests.
   Immediate, confidence 1.0: this is cryptographic-grade evidence of
@@ -89,6 +96,7 @@ DETECTORS = (
     "partitioned_clique",
     "slope_breach",
     "digest_queue_starvation",
+    "sync_stall",
     "equivocation",
 )
 
@@ -179,6 +187,12 @@ class WatchtowerConfig:
     #: starving behind ingest. A queue that merely sits deep but drains
     #: as fast as it fills does not fire — growth is the signal.
     digest_queue_growth_max_per_s: float = 50.0
+    #: a state-syncing peer may lag the quorum frontier by at least this
+    #: many rounds before the stall budget starts counting...
+    sync_stall_min_gap: int = 8
+    #: ...and must fail to close that gap for this long before the
+    #: ``sync_stall`` detector fires (re-anchored whenever it shrinks).
+    sync_stall_budget_s: float = 20.0
     #: per-(detector, accused-set) re-alert backoff, seconds.
     cooldown_s: float = 15.0
     #: alert ring bound (oldest dropped; never grows without bound).
@@ -307,6 +321,8 @@ class Watchtower:
         # Proposer digest-queue depth history per node (ROADMAP 3b: the
         # ordering-starved-behind-ingest inversion, judged by slope).
         self._digest_queue: dict[str, deque] = {}  # node -> (ts, pid, depth)
+        # State-sync stall anchors: node -> (first_ts, pid, gap at anchor).
+        self._sync_state: dict[str, tuple] = {}
         # Conveyor worker health per stream node (latest snapshot wins).
         self._worker_stats: dict[str, dict] = {}
         self._meta: dict[str, dict] = {}
@@ -474,6 +490,7 @@ class Watchtower:
         if worker:
             self._worker_stats[node] = worker
         fired += self._check_digest_queue(node, snap, gauges, ts)
+        fired += self._check_sync_stall(node, snap, gauges, ts)
         tracked = {
             k: gauges[k]
             for k in ("resource.rss_bytes", "resource.store_bytes")
@@ -562,6 +579,50 @@ class Watchtower:
              "max_per_s": bound,
              "window_s": round(secs, 1)},
             window=(base[0], ts),
+        )
+
+    def _check_sync_stall(
+        self, node: str, snap: dict, gauges: dict, ts: float
+    ) -> list[dict]:
+        """A peer stuck in state-sync: probe loop active with a frontier
+        gap that is not closing. Anchored on the first qualifying
+        snapshot; re-anchored whenever the gap shrinks (progress resets
+        the budget) or the process restarts."""
+        cfg = self.config
+        active = gauges.get("statesync.active")
+        if not isinstance(active, (int, float)) or not active:
+            self._sync_state.pop(node, None)
+            return []
+        gap = gauges.get("statesync.frontier_gap")
+        gap = gap if isinstance(gap, (int, float)) else 0
+        if gap < cfg.sync_stall_min_gap:
+            self._sync_state.pop(node, None)
+            return []
+        pid = snap.get("pid")
+        anchor = self._sync_state.get(node)
+        if anchor is None or anchor[1] != pid:
+            self._sync_state[node] = (ts, pid, gap)
+            return []
+        first_ts, _pid, anchor_gap = anchor
+        if gap < anchor_gap:
+            # Catch-up is working, just slow: restart the budget from
+            # the improved gap so only a STALL — not a long but
+            # progressing sync — ever fires.
+            self._sync_state[node] = (ts, pid, gap)
+            return []
+        elapsed = ts - first_ts
+        if elapsed < cfg.sync_stall_budget_s:
+            return []
+        return self._alert(
+            "sync_stall",
+            [node],
+            min(1.0, 0.5 + 0.5 * (elapsed / cfg.sync_stall_budget_s - 1.0)),
+            ts,
+            {"frontier_gap": gap,
+             "anchor_gap": anchor_gap,
+             "stalled_s": round(elapsed, 1),
+             "budget_s": cfg.sync_stall_budget_s},
+            window=(first_ts, ts),
         )
 
     # -- windowing -----------------------------------------------------------

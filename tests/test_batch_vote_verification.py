@@ -238,10 +238,17 @@ def test_reseat_vote_moves_author_across_buckets():
 async def test_backend_outage_does_not_blacklist_honest_votes():
     """A transient device/tunnel failure during QC batch verification must
     NOT classify the honest signatures as byzantine: after the backend
-    recovers, a resend of one vote completes the quorum and the QC forms."""
+    recovers, a resend of one vote completes the quorum and the QC forms.
+
+    The process-wide cert arena is dropped first: earlier tests in this
+    module verify the byte-identical QC (keys and chain() are
+    deterministic), and an arena hit would let the QC form without ever
+    consulting the dead backend — hiding the outage path under test."""
     from hotstuff_tpu import crypto as crypto_mod
+    from hotstuff_tpu.consensus import cert_arena
     from hotstuff_tpu.crypto import BackendUnavailable, get_backend
 
+    cert_arena.reset()
     committee = consensus_committee(BASE + 70)
     blocks = chain(1)
     me = leader_index(committee, 2)

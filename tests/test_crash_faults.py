@@ -256,9 +256,17 @@ async def test_timeout_amplification_rejoins_higher_round():
     assert len(core.network.broadcasts) == n_broadcasts
 
 
-def test_certificate_cache_skips_byte_identical_and_only_those():
+def test_certificate_cache_skips_byte_identical_and_only_those(monkeypatch):
     """A byte-identical QC that verified once skips re-verification; any
-    tampered variant misses the cache and fails from scratch."""
+    tampered variant misses the cache and fails from scratch.
+
+    The process-wide cert arena is disabled here: it deliberately
+    memoizes byte-identical certs ACROSS caches (its whole point), which
+    would hide the per-node CertificateCache contract this test pins."""
+    from hotstuff_tpu.consensus import cert_arena
+
+    monkeypatch.setenv("HOTSTUFF_CERT_ARENA", "0")
+    cert_arena.reset()
     kl = keys(4)
     committee = consensus_committee(BASE + 80)
     block_digest = Block.genesis().digest()

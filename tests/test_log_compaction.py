@@ -1,0 +1,214 @@
+"""Log-compaction and MetaLog crash-hardening tests: atomic store.log
+rewrite (Python and native engines), stale compaction temps, torn tails
+written after the atomic-replace window, and the legacy per-key fallback
+interacting with the snapshot record (ISSUE 16 satellite)."""
+
+from __future__ import annotations
+
+import os
+import struct
+
+import pytest
+
+from hotstuff_tpu.store import _HDR, LogEngine, MemEngine, MetaLog, Store
+
+from .common import async_test
+
+
+def _fill(engine, n=50, vlen=64):
+    for i in range(n):
+        engine.put(b"k%04d" % i, bytes([i % 256]) * vlen)
+
+
+# -- LogEngine.compact -------------------------------------------------------
+
+
+def test_log_compact_drops_keys_and_reclaims_bytes(tmp_path):
+    eng = LogEngine(str(tmp_path))
+    _fill(eng)
+    before = eng.size_bytes()
+    freed = eng.compact([b"k%04d" % i for i in range(40)])
+    assert freed > 0 and eng.size_bytes() == before - freed
+    assert eng.get(b"k0000") is None and eng.get(b"k0045") is not None
+    eng.close()
+
+
+def test_log_compact_squeezes_superseded_duplicates(tmp_path):
+    eng = LogEngine(str(tmp_path))
+    for _ in range(10):
+        eng.put(b"hot", b"x" * 100)  # 10 versions on disk, 1 live
+    freed = eng.compact([])  # nothing dropped — duplicates alone shrink it
+    assert freed > 0
+    assert eng.get(b"hot") == b"x" * 100
+    eng.close()
+
+
+def test_log_compact_survives_reopen(tmp_path):
+    eng = LogEngine(str(tmp_path))
+    _fill(eng, n=20)
+    eng.compact([b"k%04d" % i for i in range(10)])
+    eng.put(b"after", b"compaction")  # appends continue on the new log
+    eng.close()
+    eng2 = LogEngine(str(tmp_path))
+    assert eng2.get(b"k0000") is None
+    assert eng2.get(b"k0015") is not None
+    assert eng2.get(b"after") == b"compaction"
+    eng2.close()
+
+
+def test_log_compact_unknown_keys_retained(tmp_path):
+    eng = LogEngine(str(tmp_path))
+    _fill(eng, n=5)
+    eng.compact([b"not-present"])
+    for i in range(5):
+        assert eng.get(b"k%04d" % i) is not None
+    eng.close()
+
+
+def test_stale_compaction_tmp_discarded_on_open(tmp_path):
+    eng = LogEngine(str(tmp_path))
+    _fill(eng, n=5)
+    eng.close()
+    # Crash inside a compaction's write window: a partial tmp survives
+    # beside the intact live log. It must be discarded, never adopted.
+    tmp = os.path.join(str(tmp_path), "store.log.tmp")
+    with open(tmp, "wb") as f:
+        f.write(b"half a compaction")
+    eng2 = LogEngine(str(tmp_path))
+    assert not os.path.exists(tmp)
+    for i in range(5):
+        assert eng2.get(b"k%04d" % i) is not None
+    eng2.close()
+
+
+def test_native_engine_compact_parity(tmp_path):
+    native = pytest.importorskip("hotstuff_tpu.store.native")
+    try:
+        eng = native.NativeEngine(str(tmp_path))
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    _fill(eng, n=30)
+    before = eng.size_bytes()
+    freed = eng.compact([b"k%04d" % i for i in range(20)])
+    assert freed > 0 and eng.size_bytes() < before
+    assert eng.get(b"k0000") is None and eng.get(b"k0025") is not None
+    eng.close()
+    # Compacted log replays identically in the PYTHON engine: the two
+    # engines stay interchangeable on disk across a truncation.
+    pyeng = LogEngine(str(tmp_path))
+    assert pyeng.get(b"k0000") is None and pyeng.get(b"k0025") is not None
+    pyeng.close()
+
+
+def test_mem_engine_compact(tmp_path):
+    eng = MemEngine()
+    _fill(eng, n=10)
+    assert eng.compact([b"k0000", b"missing"]) > 0
+    assert eng.get(b"k0000") is None and eng.get(b"k0005") is not None
+
+
+@async_test
+async def test_store_compact_noop_without_engine_support():
+    class Bare:
+        def put(self, k, v): ...
+        def get(self, k): return None
+        def close(self): ...
+
+    store = Store(engine=Bare())
+    assert await store.compact([b"x"]) == 0
+
+
+# -- MetaLog crash hardening -------------------------------------------------
+
+
+def test_metalog_torn_tail_after_compaction_window(tmp_path):
+    """A torn append landing AFTER an in-place compaction (atomic replace)
+    must truncate cleanly on replay: the compacted prefix survives, the
+    torn record is dropped, and subsequent appends parse."""
+    ml = MetaLog(str(tmp_path))
+    for i in range(8):
+        ml.put(b"round", str(i).encode())
+    ml.put(b"floor", b"42")
+    ml._compact()  # in-place atomic replace: 2 live records remain
+    ml.put(b"round", b"9")
+    ml.close()
+    path = os.path.join(str(tmp_path), "meta.log")
+    # Crash mid-append: header promises more bytes than were written.
+    with open(path, "ab") as f:
+        f.write(_HDR.pack(5, 100) + b"tornk" + b"only-part")
+    ml2 = MetaLog(str(tmp_path))
+    assert ml2.get(b"round") == b"9"
+    assert ml2.get(b"floor") == b"42"
+    assert ml2.get(b"tornk") is None
+    ml2.put(b"round", b"10")  # post-recovery appends must parse on replay
+    ml2.close()
+    ml3 = MetaLog(str(tmp_path))
+    assert ml3.get(b"round") == b"10"
+    ml3.close()
+
+
+def test_metalog_stale_compaction_tmp_discarded(tmp_path):
+    ml = MetaLog(str(tmp_path))
+    ml.put(b"k", b"live")
+    ml.close()
+    tmp = os.path.join(str(tmp_path), "meta.log.tmp")
+    with open(tmp, "wb") as f:
+        f.write(_HDR.pack(1, 1) + b"kX")  # plausible but stale generation
+    ml2 = MetaLog(str(tmp_path))
+    assert not os.path.exists(tmp)
+    assert ml2.get(b"k") == b"live"
+    ml2.close()
+
+
+def test_metalog_legacy_fallback_reads_snapshot_record(tmp_path):
+    """A node restarted across the per-key-file -> MetaLog layout change
+    must still see a snapshot record written by its previous life, and a
+    new MetaLog put must shadow the legacy file from then on."""
+    from hotstuff_tpu.consensus.statesync import SNAPSHOT_KEY
+
+    legacy_value = b"snapshot-from-previous-layout"
+    ml = MetaLog(str(tmp_path))
+    legacy = ml._legacy_path(SNAPSHOT_KEY)
+    ml.close()
+    with open(legacy, "wb") as f:
+        f.write(legacy_value)
+    ml2 = MetaLog(str(tmp_path))
+    assert ml2.get(SNAPSHOT_KEY) == legacy_value
+    ml2.put(SNAPSHOT_KEY, b"new-layout-record")
+    assert ml2.get(SNAPSHOT_KEY) == b"new-layout-record"
+    ml2.close()
+    ml3 = MetaLog(str(tmp_path))  # the shadow persists across reopen
+    assert ml3.get(SNAPSHOT_KEY) == b"new-layout-record"
+    ml3.close()
+
+
+def test_metalog_torn_tail_with_legacy_fallback_present(tmp_path):
+    """Torn tail recovery must not fall back to a STALE legacy record for
+    a key whose live MetaLog record survived intact before the tear."""
+    from hotstuff_tpu.consensus.statesync import SNAPSHOT_KEY
+
+    ml = MetaLog(str(tmp_path))
+    legacy = ml._legacy_path(SNAPSHOT_KEY)
+    ml.put(SNAPSHOT_KEY, b"current")
+    ml.close()
+    with open(legacy, "wb") as f:
+        f.write(b"ancient")
+    path = os.path.join(str(tmp_path), "meta.log")
+    with open(path, "ab") as f:
+        f.write(_HDR.pack(3, 50) + b"abc")  # torn: value bytes missing
+    ml2 = MetaLog(str(tmp_path))
+    assert ml2.get(SNAPSHOT_KEY) == b"current"
+    ml2.close()
+
+
+def test_metalog_torn_header_alone(tmp_path):
+    ml = MetaLog(str(tmp_path))
+    ml.put(b"a", b"1")
+    ml.close()
+    path = os.path.join(str(tmp_path), "meta.log")
+    with open(path, "ab") as f:
+        f.write(struct.pack("<I", 7))  # half a header
+    ml2 = MetaLog(str(tmp_path))
+    assert ml2.get(b"a") == b"1"
+    assert os.path.getsize(path) == _HDR.size + 2  # tear truncated away
+    ml2.close()

@@ -1,0 +1,341 @@
+"""Lazarus state-sync tests: snapshot codec + 2-chain proof soundness
+(structural and cryptographic tamper rejection), Compactor snapshot/
+truncate behavior over a real store, the frontier-availability checker
+invariant, and the Watchtower ``sync_stall`` detector fixtures."""
+
+from __future__ import annotations
+
+import pytest
+
+from hotstuff_tpu import telemetry
+from hotstuff_tpu.consensus.messages import QC, Block
+from hotstuff_tpu.consensus.statesync import (
+    SNAPSHOT_KEY,
+    Compactor,
+    Snapshot,
+    SnapshotError,
+    decode_snapshot,
+    encode_snapshot,
+    peek_frontier,
+    verify_snapshot,
+)
+from hotstuff_tpu.crypto import Signature
+from hotstuff_tpu.store import Store
+
+from .common import async_test, chain, consensus_committee, keys
+
+
+@pytest.fixture(autouse=True)
+def _isolated_telemetry():
+    telemetry.reset_for_tests()
+    yield
+    telemetry.reset_for_tests()
+
+
+def _proof(n: int = 6, k: int = 2):
+    """(frontier, child, cert) from a valid chain: F = block at round k+1,
+    c1 its consecutive child, cert the QC certifying c1 (carried by the
+    block above c1)."""
+    blocks = chain(n)
+    return blocks, blocks[k], blocks[k + 1], blocks[k + 2].qc
+
+
+# -- codec + structural proof checks ----------------------------------------
+
+
+def test_snapshot_roundtrip_and_peek():
+    _, frontier, child, cert = _proof()
+    raw = encode_snapshot(frontier, child, cert, last_voted_round=7)
+    assert peek_frontier(raw) == (frontier.round, frontier.digest())
+    snap = decode_snapshot(raw)
+    assert snap.frontier.digest() == frontier.digest()
+    assert snap.child.digest() == child.digest()
+    assert snap.cert.hash == cert.hash and snap.cert.round == cert.round
+    assert snap.last_voted_round == 7
+
+
+def test_snapshot_rejects_unknown_version():
+    _, frontier, child, cert = _proof()
+    raw = encode_snapshot(frontier, child, cert, 0)
+    with pytest.raises(SnapshotError):
+        decode_snapshot(b"\xff" + raw[1:])
+    with pytest.raises(SnapshotError):
+        peek_frontier(b"\xff" + raw[1:])
+
+
+def test_snapshot_rejects_truncated_record():
+    _, frontier, child, cert = _proof()
+    raw = encode_snapshot(frontier, child, cert, 0)
+    with pytest.raises(SnapshotError):
+        decode_snapshot(raw[: len(raw) // 2])
+    with pytest.raises(SnapshotError):
+        decode_snapshot(raw + b"\x00")  # trailing garbage must not parse
+
+
+def test_snapshot_rejects_header_frontier_mismatch():
+    blocks, frontier, child, cert = _proof()
+    # Swap the frontier block for a different one while keeping the header:
+    # peek_frontier answers from the header, so the full decode must verify
+    # the header actually matches the embedded block.
+    honest = encode_snapshot(frontier, child, cert, 0)
+    forged = encode_snapshot(blocks[0], child, cert, 0)
+    # Splice honest header (ver + u64 round + 32B digest) onto forged body.
+    with pytest.raises(SnapshotError):
+        decode_snapshot(honest[:41] + forged[41:])
+
+
+def test_snapshot_rejects_nonconsecutive_child():
+    blocks = chain(6)
+    # blocks[4].qc certifies blocks[3], not blocks[2]: child does not
+    # certify the claimed frontier.
+    with pytest.raises(SnapshotError):
+        decode_snapshot(encode_snapshot(blocks[2], blocks[4], blocks[5].qc, 0))
+
+
+def test_snapshot_rejects_cert_for_wrong_block():
+    blocks = chain(6)
+    # cert certifies blocks[4], not the child blocks[3].
+    with pytest.raises(SnapshotError):
+        decode_snapshot(encode_snapshot(blocks[2], blocks[3], blocks[5].qc, 0))
+
+
+def test_snapshot_rejects_genesis_frontier():
+    blocks = chain(3)
+    fake = Snapshot(blocks[0], blocks[1], blocks[2].qc, 0)
+    raw = encode_snapshot(fake.frontier, fake.child, fake.cert, 0)
+    # Round-1 frontier is fine; a genesis (round-0) frontier can't exist in
+    # a well-formed record because Block round 0 is the genesis sentinel —
+    # assert decode of the valid boundary still works.
+    assert decode_snapshot(raw).frontier.round == 1
+
+
+# -- cryptographic verification ---------------------------------------------
+
+
+@async_test
+async def test_verify_snapshot_accepts_honest_proof():
+    _, frontier, child, cert = _proof()
+    raw = encode_snapshot(frontier, child, cert, 0)
+    committee = consensus_committee(9300)
+    await verify_snapshot(decode_snapshot(raw), committee)
+
+
+@async_test
+async def test_verify_snapshot_rejects_forged_cert_votes():
+    _, frontier, child, cert = _proof()
+    # Keep the topology valid but re-sign the cert with the wrong key:
+    # structural decode passes, signature verification must not.
+    key_list = keys()
+    wrong_sk = key_list[0][1]
+    forged = QC(
+        hash=cert.hash,
+        round=cert.round,
+        votes=[(pk, Signature.new(cert.digest(), wrong_sk)) for pk, _ in key_list],
+    )
+    raw = encode_snapshot(frontier, child, forged, 0)
+    committee = consensus_committee(9310)
+    with pytest.raises(Exception):
+        await verify_snapshot(decode_snapshot(raw), committee)
+
+
+# -- Compactor: snapshot + truncate over a real store -----------------------
+
+
+class _CoreStub:
+    def __init__(self, store, last_committed_round, last_voted_round=0):
+        self.store = store
+        self.last_committed_round = last_committed_round
+        self.last_voted_round = last_voted_round
+        self.synchronizer = self
+
+    def note_floor(self, frontier):
+        self.floor = frontier
+
+
+@async_test
+async def test_compactor_truncates_below_frontier(tmp_path):
+    blocks = chain(20)
+    store = Store(str(tmp_path / "db"))
+    for b in blocks:
+        await store.write(b.digest().data, b.serialize())
+    comp = Compactor(store, retention_rounds=4)
+    for b in blocks:
+        comp.note_commit(b)
+    core = _CoreStub(store, last_committed_round=18)
+    await comp.maybe_compact(core)
+    raw = await store.read_meta(SNAPSHOT_KEY)
+    assert raw is not None, "snapshot record must be written"
+    snap = decode_snapshot(raw)
+    assert snap.frontier.round <= 18 - 4
+    assert core.floor.digest() == snap.frontier.digest()
+    # Everything strictly below the frontier is gone; F and above survive.
+    for b in blocks:
+        data = await store.read(b.digest().data)
+        if b.round < snap.frontier.round:
+            assert data is None, f"round {b.round} should be truncated"
+        else:
+            assert data is not None, f"round {b.round} should survive"
+    store.close()
+
+
+@async_test
+async def test_compactor_hysteresis_no_op_below_threshold(tmp_path):
+    blocks = chain(10)
+    store = Store(str(tmp_path / "db"))
+    for b in blocks:
+        await store.write(b.digest().data, b.serialize())
+    comp = Compactor(store, retention_rounds=8)
+    for b in blocks:
+        comp.note_commit(b)
+    # head - snapshot(0) = 10 < 2*8: must not snapshot yet.
+    await comp.maybe_compact(_CoreStub(store, last_committed_round=10))
+    assert await store.read_meta(SNAPSHOT_KEY) is None
+    store.close()
+
+
+@async_test
+async def test_compactor_snapshot_survives_reopen(tmp_path):
+    blocks = chain(20)
+    path = str(tmp_path / "db")
+    store = Store(path)
+    for b in blocks:
+        await store.write(b.digest().data, b.serialize())
+    comp = Compactor(store, retention_rounds=4)
+    for b in blocks:
+        comp.note_commit(b)
+    await comp.maybe_compact(_CoreStub(store, last_committed_round=18))
+    raw = await store.read_meta(SNAPSHOT_KEY)
+    store.close()
+    store2 = Store(path)
+    assert await store2.read_meta(SNAPSHOT_KEY) == raw
+    snap = decode_snapshot(raw)
+    assert await store2.read(snap.frontier.digest().data) is not None
+    for b in blocks:
+        if b.round < snap.frontier.round:
+            assert await store2.read(b.digest().data) is None
+    store2.close()
+
+
+# -- frontier-availability checker ------------------------------------------
+
+
+def _schedule(nodes=("n0", "n1", "n2", "n3")):
+    from hotstuff_tpu.faultline.policy import Schedule
+
+    return Schedule(scenario="t", seed=0, nodes=list(nodes))
+
+
+def test_frontier_availability_ok_via_resolvers():
+    from hotstuff_tpu.faultline.checker import check_frontier_availability
+
+    committed = {(1, b"a"), (2, b"b")}
+    resolvers = {b"a": {"n0", "n1"}, b"b": {"n0", "n1", "n2"}}
+    verdict = check_frontier_availability(_schedule(), committed, resolvers, {})
+    assert verdict["ok"] and verdict["required_servers"] == 2
+    assert verdict["checked"] == 2 and verdict["violations"] == []
+
+
+def test_frontier_availability_snapshot_floor_serves_truncated_block():
+    from hotstuff_tpu.faultline.checker import check_frontier_availability
+
+    # Block at round 5 resolvable only at n0; n1 truncated it but its
+    # snapshot floor (>= 5) subsumes it — still two servers.
+    committed = {(5, b"x")}
+    verdict = check_frontier_availability(
+        _schedule(), committed, {b"x": {"n0"}}, {"n1": 7}
+    )
+    assert verdict["ok"]
+    # A floor BELOW the block's round does not serve it.
+    verdict = check_frontier_availability(
+        _schedule(), committed, {b"x": {"n0"}}, {"n1": 4}
+    )
+    assert not verdict["ok"]
+    assert verdict["violations"][0]["type"] == "unservable_commit"
+
+
+def test_frontier_availability_excludes_byzantine_servers():
+    from hotstuff_tpu.faultline.checker import check_frontier_availability
+    from hotstuff_tpu.faultline.policy import FaultEvent
+
+    sched = _schedule()
+    sched.events.append(
+        FaultEvent(at=0.0, kind="byzantine", params={"node": "n1", "behavior": "equivocate"})
+    )
+    committed = {(3, b"y")}
+    # Only byzantine n1 plus honest n0 resolve it: one honest server < f+1.
+    verdict = check_frontier_availability(
+        sched, committed, {b"y": {"n0", "n1"}}, {}
+    )
+    assert not verdict["ok"]
+
+
+# -- Watchtower sync_stall detector -----------------------------------------
+
+
+def _sync_snapshot(ts, node, pid, active, gap):
+    return {
+        "schema": "hotstuff-telemetry-v1",
+        "node": node,
+        "pid": pid,
+        "seq": 0,
+        "ts": ts,
+        "final": False,
+        "counters": {},
+        "gauges": {"statesync.active": active, "statesync.frontier_gap": gap},
+        "histograms": {},
+    }
+
+
+def test_sync_stall_fires_when_gap_never_closes():
+    from hotstuff_tpu.telemetry.watchtower import Watchtower, WatchtowerConfig
+
+    watch = Watchtower(WatchtowerConfig(sync_stall_budget_s=20.0))
+    fired = []
+    for i in range(6):
+        fired += watch.ingest_record(
+            _sync_snapshot(i * 5.0, "n3", 7, active=1, gap=40), source="s"
+        )
+    alerts = [a for a in fired if a["detector"] == "sync_stall"]
+    assert alerts and alerts[0]["accused"] == ["n3"]
+    assert alerts[0]["evidence"]["frontier_gap"] == 40
+
+
+def test_sync_stall_quiet_while_gap_shrinks():
+    from hotstuff_tpu.telemetry.watchtower import Watchtower, WatchtowerConfig
+
+    watch = Watchtower(WatchtowerConfig(sync_stall_budget_s=20.0))
+    fired = []
+    for i, gap in enumerate([64, 48, 32, 16, 9, 8]):
+        fired += watch.ingest_record(
+            _sync_snapshot(i * 5.0, "n3", 7, active=1, gap=gap), source="s"
+        )
+    assert [a for a in fired if a["detector"] == "sync_stall"] == []
+
+
+def test_sync_stall_resets_on_restart_and_inactive():
+    from hotstuff_tpu.telemetry.watchtower import Watchtower, WatchtowerConfig
+
+    watch = Watchtower(WatchtowerConfig(sync_stall_budget_s=20.0))
+    fired = []
+    # Stalled under pid 7, but the node restarts (pid 9) before the budget:
+    # the anchor must reset, not accumulate across lives.
+    fired += watch.ingest_record(_sync_snapshot(0.0, "n3", 7, 1, 40), "s")
+    fired += watch.ingest_record(_sync_snapshot(15.0, "n3", 9, 1, 40), "s")
+    fired += watch.ingest_record(_sync_snapshot(25.0, "n3", 9, 1, 40), "s")
+    assert [a for a in fired if a["detector"] == "sync_stall"] == []
+    # Sync completing (active=0) clears the anchor too.
+    fired += watch.ingest_record(_sync_snapshot(30.0, "n3", 9, 0, 0), "s")
+    fired += watch.ingest_record(_sync_snapshot(50.0, "n3", 9, 0, 0), "s")
+    assert [a for a in fired if a["detector"] == "sync_stall"] == []
+
+
+def test_sync_stall_ignores_small_gaps():
+    from hotstuff_tpu.telemetry.watchtower import Watchtower, WatchtowerConfig
+
+    watch = Watchtower(WatchtowerConfig(sync_stall_budget_s=20.0, sync_stall_min_gap=8))
+    fired = []
+    for i in range(8):
+        fired += watch.ingest_record(
+            _sync_snapshot(i * 5.0, "n3", 7, active=1, gap=3), source="s"
+        )
+    assert [a for a in fired if a["detector"] == "sync_stall"] == []
