@@ -98,6 +98,7 @@ class Consensus:
         self.receivers: list[Receiver] = []
         self.synchronizer: Synchronizer | None = None
         self.mempool_driver: MempoolDriver | None = None
+        self.compactor = None
 
     @classmethod
     async def spawn(
@@ -170,7 +171,7 @@ class Consensus:
         # and runs the (dormant-while-healthy) anti-entropy tick; the
         # compactor arms only when a retention depth is configured.
         statesync = StateSync(name, committee, parameters.sync_retry_delay)
-        compactor = (
+        self.compactor = compactor = (
             Compactor(store, parameters.retention_rounds)
             if parameters.retention_rounds > 0
             else None
@@ -212,10 +213,18 @@ class Consensus:
                 wire_seats=wire_seats,
             )
         )
-        self.tasks.append(Helper.spawn(committee, store, tx_helper))
+        self.tasks.append(
+            Helper.spawn(
+                committee, store, tx_helper, parameters.sync_retry_delay
+            )
+        )
         return self
 
     async def shutdown(self) -> None:
+        # Let an in-flight background log rewrite finish before the store
+        # is closed underneath its executor thread.
+        if self.compactor is not None:
+            await self.compactor.drain()
         for t in self.tasks:
             t.cancel()
         if self.synchronizer is not None:

@@ -6,6 +6,7 @@ from __future__ import annotations
 
 import asyncio
 import logging
+import time
 
 from hotstuff_tpu.network import SimpleSender
 from hotstuff_tpu.store import Store
@@ -37,9 +38,23 @@ CHAIN_DEPTH = 16
 class Helper:
     @classmethod
     def spawn(
-        cls, committee: Committee, store: Store, rx_request: asyncio.Queue
+        cls,
+        committee: Committee,
+        store: Store,
+        rx_request: asyncio.Queue,
+        sync_retry_delay: int = 5_000,
     ) -> asyncio.Task:
         network = SimpleSender()
+        # Snapshot replies are heavy (two blocks + a 2f+1-signature QC)
+        # and the request's origin field is unsigned and spoofable: an
+        # attacker spraying unknown digests with a victim's origin would
+        # otherwise have every helper amplify traffic at the victim. One
+        # snapshot reply per origin per half retry window caps the
+        # amplification at a trickle while never throttling an honest
+        # straggler (its synchronizer re-asks at sync_retry cadence). The
+        # map is bounded by committee size (unknown origins are rejected).
+        snap_interval_s = sync_retry_delay / 2_000.0
+        snap_last_sent: dict = {}
 
         async def run():
             while True:
@@ -82,6 +97,13 @@ class Helper:
                         # record (frontier + 2-chain commit proof) so a
                         # cold joiner establishes a verified floor instead
                         # of re-requesting an unservable block forever.
+                        # Rate-limited per origin (and checked BEFORE the
+                        # meta read) so forged requests cost the server
+                        # and the accused origin almost nothing.
+                        now = time.monotonic()
+                        last = snap_last_sent.get(origin)
+                        if last is not None and now - last < snap_interval_s:
+                            continue
                         snap = await store.read_meta(SNAPSHOT_KEY)
                         if snap is not None:
                             try:
@@ -89,6 +111,7 @@ class Helper:
                             except SnapshotError as e:
                                 log.error("corrupt snapshot record: %s", e)
                             else:
+                                snap_last_sent[origin] = now
                                 network.send(
                                     address,
                                     encode_state_response(

@@ -32,6 +32,15 @@ byzantine peer cannot present a certified-but-abandoned fork block as a
 committed frontier: no such block ever collects the consecutive-round
 child certificate.
 
+The record carries NOTHING outside that certified content. Every field a
+joiner adopts (commit floor, high QC, last-voted floor) derives from the
+two blocks and two QCs the proof covers — an earlier draft carried the
+creator's ``last_voted_round`` as a voting-state hint, but the hint was
+certified by neither QC, so a byzantine peer could attach ``2^64-1`` to an
+otherwise-valid record and permanently mute any honest installer (it would
+never satisfy ``block.round > last_voted_round`` again, surviving restarts
+via the persisted state). Unauthenticated hints must never be adopted.
+
 Crash discipline: the snapshot record is fsynced BEFORE the log rewrite
 (a crash between them restarts with the floor known and the old log
 intact); the rewrite itself is tmp + fsync + ``os.replace`` (see
@@ -40,10 +49,12 @@ intact); the rewrite itself is tmp + fsync + ``os.replace`` (see
 
 from __future__ import annotations
 
+import asyncio
 import logging
 
 from hotstuff_tpu import telemetry
 from hotstuff_tpu.crypto import Digest, PublicKey
+from hotstuff_tpu.store import StoreError
 from hotstuff_tpu.utils.serde import Decoder, Encoder, SerdeError
 
 from .config import Committee
@@ -62,7 +73,9 @@ log = logging.getLogger("consensus")
 #: data log — so truncation can never drop its own floor record).
 SNAPSHOT_KEY = b"__store_snapshot__"
 
-_SNAPSHOT_VERSION = 1
+# v2 dropped the trailing ``last_voted_round`` hint: it was certified by
+# neither QC, so adopting it let a byzantine record mute an honest joiner.
+_SNAPSHOT_VERSION = 2
 
 
 class SnapshotError(ConsensusError):
@@ -72,25 +85,20 @@ class SnapshotError(ConsensusError):
 class Snapshot:
     """Decoded snapshot record: frontier ``F``, its consecutive-round child
     ``c1`` (whose ``qc`` certifies ``F``), and ``cert`` — the QC certifying
-    ``c1``. ``last_voted_round`` is the creator's voting-state hint."""
+    ``c1``."""
 
-    __slots__ = ("frontier", "child", "cert", "last_voted_round")
+    __slots__ = ("frontier", "child", "cert")
 
-    def __init__(
-        self, frontier: Block, child: Block, cert: QC, last_voted_round: int
-    ) -> None:
+    def __init__(self, frontier: Block, child: Block, cert: QC) -> None:
         self.frontier = frontier
         self.child = child
         self.cert = cert
-        self.last_voted_round = last_voted_round
 
     def __repr__(self) -> str:
         return f"Snapshot(F=r{self.frontier.round}, c1=r{self.child.round})"
 
 
-def encode_snapshot(
-    frontier: Block, child: Block, cert: QC, last_voted_round: int
-) -> bytes:
+def encode_snapshot(frontier: Block, child: Block, cert: QC) -> bytes:
     # The frontier (round, digest) leads the record so servers can answer
     # probes from it without deserializing two blocks (see peek_frontier).
     enc = Encoder()
@@ -98,7 +106,6 @@ def encode_snapshot(
     enc.u64(frontier.round).raw(frontier.digest().data)
     enc.bytes(frontier.serialize()).bytes(child.serialize())
     cert.encode(enc)
-    enc.u64(last_voted_round)
     return enc.finish()
 
 
@@ -124,7 +131,6 @@ def decode_snapshot(data: bytes) -> Snapshot:
         frontier = Block.deserialize(dec.bytes())
         child = Block.deserialize(dec.bytes())
         cert = QC.decode(dec)
-        last_voted_round = dec.u64()
         dec.finish()
     except (SerdeError, ValueError) as e:
         raise SnapshotError(f"malformed snapshot record: {e}") from e
@@ -138,7 +144,7 @@ def decode_snapshot(data: bytes) -> Snapshot:
         raise SnapshotError("child is not the frontier's consecutive round")
     if cert.hash != child.digest() or cert.round != child.round:
         raise SnapshotError("cert does not certify child")
-    return Snapshot(frontier, child, cert, last_voted_round)
+    return Snapshot(frontier, child, cert)
 
 
 async def verify_snapshot(snap: Snapshot, committee: Committee, cache=None) -> None:
@@ -176,6 +182,16 @@ class StateSync:
         self._peers = [pk for pk, _ in committee.broadcast_addresses(name)]
         self._next_peer = 0
         self._last_seen_commit = -1
+        # At most ONE direct frontier pull in flight (see _request_frontier):
+        # frontier claims in state_responses are unauthenticated, so each
+        # must be bounded in what it can allocate.
+        self._pull: Digest | None = None
+        self._pull_ticks = 0
+        # Per-origin tick index of the last snapshot reply (server side):
+        # snapshot records are heavy (two blocks + a 2f+1-signature QC), so
+        # replies are rate-limited to the probe cadence per origin.
+        self._snap_served: dict[PublicKey, int] = {}
+        self._tick_no = 0
         self._g_active = telemetry.gauge("statesync.active")
         self._g_gap = telemetry.gauge("statesync.frontier_gap")
         self._m_probes = telemetry.counter("statesync.probes_sent")
@@ -205,7 +221,7 @@ class StateSync:
                 if snap.frontier.round > core.last_committed_round:
                     core.last_committed_round = snap.frontier.round
                     core._last_committed_digest = snap.frontier.digest()
-                core.increase_last_voted_round(snap.last_voted_round)
+                core.increase_last_voted_round(snap.child.round)
                 core.update_high_qc(snap.cert)
                 if core.round <= snap.cert.round:
                     core.round = snap.cert.round + 1
@@ -217,8 +233,27 @@ class StateSync:
 
     # -- probe loop (requester side) -----------------------------------------
 
+    #: Ticks before an unresolved direct frontier pull is presumed bogus
+    #: and cancelled (the retry timer got >= 2 full-committee rebroadcast
+    #: windows by then; a servable block resolves far sooner).
+    PULL_TTL_TICKS = 3
+
     async def handle_tick(self, _payload=None) -> None:
         core = self._core
+        self._tick_no += 1
+        if self._pull is not None:
+            if not core.synchronizer.requested(self._pull):
+                self._pull = None  # resolved: the slot frees
+            else:
+                self._pull_ticks += 1
+                if self._pull_ticks >= self.PULL_TTL_TICKS:
+                    # An unauthenticated frontier claim pointed us at a
+                    # digest no peer serves: evict it (cancelling releases
+                    # the request entries, the store obligation, and the
+                    # waiter task) so state sync cannot wedge on it and a
+                    # byzantine stream cannot accumulate state.
+                    core.synchronizer.cancel_request(self._pull)
+                    self._pull = None
         if core.last_committed_round > self._last_seen_commit:
             # Commits progressed since the last tick: dormant. (An idle
             # committee still advances rounds and commits empty blocks, so
@@ -261,18 +296,27 @@ class StateSync:
         if digest is None:
             return  # nothing committed yet: nothing to serve
         snapshot = None
-        data = await core.store.read_meta(SNAPSHOT_KEY)
-        if data is not None:
-            try:
-                snap_round, _ = peek_frontier(data)
-            except SnapshotError:
-                snap_round = None
-            # Below our truncation horizon the requester can never heal by
-            # chain replay from us — attach the snapshot so it can
-            # establish a floor. (At or above the horizon the ordinary
-            # chain machinery serves everything; skip the heavy record.)
-            if snap_round is not None and since_round < snap_round:
-                snapshot = data
+        # The origin field is unsigned and spoofable, and the snapshot
+        # record is heavy (two blocks + a 2f+1-signature QC): rate-limit
+        # snapshot attachment per claimed origin to the probe cadence so a
+        # spray of forged requests cannot amplify traffic at a victim.
+        # Honest joiners probe each peer at most once per rotation of the
+        # tick loop, so this never throttles a real catch-up. The map is
+        # bounded by committee size (unknown origins returned above).
+        if self._snap_served.get(origin) != self._tick_no:
+            data = await core.store.read_meta(SNAPSHOT_KEY)
+            if data is not None:
+                try:
+                    snap_round, _ = peek_frontier(data)
+                except SnapshotError:
+                    snap_round = None
+                # Below our truncation horizon the requester can never heal
+                # by chain replay from us — attach the snapshot so it can
+                # establish a floor. (At or above the horizon the ordinary
+                # chain machinery serves everything; skip the heavy record.)
+                if snap_round is not None and since_round < snap_round:
+                    snapshot = data
+                    self._snap_served[origin] = self._tick_no
         core.network.send(
             address,
             encode_state_response(core.last_committed_round, digest, snapshot),
@@ -306,9 +350,22 @@ class StateSync:
             self._request_frontier(frontier_digest)
 
     def _request_frontier(self, digest: Digest) -> None:
+        """Solicit the claimed frontier block — at most ONE such direct
+        pull in flight. The (round, digest) claim in a state_response is
+        unauthenticated, so an unbounded pull per response would let a
+        byzantine peer grow a request entry, a store obligation, and a
+        waiter task per forged digest, forever. One slot, freed on
+        resolution or evicted after ``PULL_TTL_TICKS`` (see handle_tick),
+        bounds the damage to O(1); honest catch-up needs only one frontier
+        walk at a time anyway."""
+        sync = self._core.synchronizer
+        if self._pull is not None and sync.requested(self._pull):
+            return  # slot busy: the retry timer is still driving it
         pk = self._peers[self._next_peer % len(self._peers)] if self._peers else None
         address = self.committee.address(pk) if pk is not None else None
-        self._core.synchronizer.request_block(digest, address)
+        self._pull = digest
+        self._pull_ticks = 0
+        sync.request_block(digest, address)
 
     async def _install(self, snap: Snapshot, raw: bytes) -> None:
         """Adopt a VERIFIED snapshot: persist the floor record first
@@ -327,11 +384,11 @@ class StateSync:
             core.last_committed_round, snap.frontier.round
         )
         core._last_committed_digest = snap.frontier.digest()
-        # Never vote at or below the adopted window: the creator's hint
-        # covers rounds where OUR pre-wipe votes may live on.
-        core.increase_last_voted_round(
-            max(snap.last_voted_round, snap.child.round)
-        )
+        # Never vote at or below the adopted window — but raise the floor
+        # ONLY to what the certificates prove (c1's round). Rounds above
+        # that are unproven by this record, and adopting any unauthenticated
+        # hint here would let a byzantine snapshot mute this node forever.
+        core.increase_last_voted_round(snap.child.round)
         await core.process_qc(snap.cert)  # adopt high_qc, enter cert.round+1
         await core._persist_state()
         # Writing F releases notify_read waiters of blocks suspended on it
@@ -353,6 +410,7 @@ class Compactor:
         self.retention = retention_rounds
         self._snapshot_round = 0
         self._head: Block | None = None
+        self._rewrite_task = None  # in-flight background log rewrite
         self._m_compactions = telemetry.counter("store.compactions")
         self._m_freed = telemetry.counter("store.compaction_bytes_freed")
         self._g_snapshot_round = telemetry.gauge("store.snapshot_round")
@@ -372,6 +430,8 @@ class Compactor:
     async def maybe_compact(self, core) -> None:
         if self.retention <= 0 or self._head is None:
             return
+        if self._rewrite_task is not None and not self._rewrite_task.done():
+            return  # previous rewrite still running off-loop
         if core.last_committed_round - self._snapshot_round < 2 * self.retention:
             return
         target = core.last_committed_round - self.retention
@@ -392,7 +452,7 @@ class Compactor:
         else:
             return  # no consecutive-round pair in reach — retry next commit
         frontier, c1, cert = parent, child, above.qc
-        snapshot = encode_snapshot(frontier, c1, cert, core.last_voted_round)
+        snapshot = encode_snapshot(frontier, c1, cert)
         # Floor record FIRST, durably: a crash between this write and the
         # log rewrite restarts with the floor known and the old log whole.
         await self.store.write_meta(SNAPSHOT_KEY, snapshot, sync=True)
@@ -406,15 +466,46 @@ class Compactor:
             for d in cur.payload:
                 drop.append(d.data)
             cur = await self._read_parent(cur)
-        freed = await self.store.compact(drop)
+        # The floor is durable and the drop set is walked: adopt the
+        # snapshot NOW — the log rewrite only reclaims space and must not
+        # hold up the commit path (store engines run the bulk copy on an
+        # executor; see Store.compact). On the real plane it runs as a
+        # background task so this node keeps voting while the file is
+        # rewritten; the sim plane (MemEngine, no executor, no tasks)
+        # compacts inline, which is a dict pop there.
         self._snapshot_round = frontier.round
         core.synchronizer.note_floor(frontier)
-        self._m_compactions.inc()
-        self._m_freed.inc(freed)
         self._g_snapshot_round.set(frontier.round)
-        log.info(
-            "snapshot at r%d: dropped %d keys below the floor, freed %d bytes",
-            frontier.round,
-            len(drop),
-            freed,
-        )
+
+        async def _rewrite() -> None:
+            try:
+                freed = await self.store.compact(drop)
+            except (StoreError, OSError) as e:
+                # The old log stays live (engines restore their append
+                # handle on every failure path); space is reclaimed at
+                # the next trigger.
+                log.error("log compaction failed (will retry): %s", e)
+                return
+            self._m_compactions.inc()
+            self._m_freed.inc(freed)
+            log.info(
+                "snapshot at r%d: dropped %d keys below the floor, "
+                "freed %d bytes",
+                frontier.round,
+                len(drop),
+                freed,
+            )
+
+        if self.store.compaction_offloaded():
+            self._rewrite_task = asyncio.create_task(
+                _rewrite(), name="store_compaction"
+            )
+        else:
+            await _rewrite()
+
+    async def drain(self) -> None:
+        """Wait for an in-flight background rewrite (tests, shutdown —
+        the store must not be closed under a live rewrite thread)."""
+        if self._rewrite_task is not None:
+            await self._rewrite_task
+            self._rewrite_task = None

@@ -59,6 +59,10 @@ class Synchronizer:
         # stop at F instead of suspending on an unservable parent.
         self._floor: Digest | None = None
         self._floor_round = 0
+        # digest -> waiter task for DIRECT pulls (request_block), so a
+        # caller can cancel one that will never resolve (see
+        # cancel_request) without leaking the store obligation.
+        self._direct: dict[Digest, asyncio.Task] = {}
         self._tasks: set[asyncio.Task] = set()
         self._main = asyncio.create_task(self._run(), name="consensus_synchronizer")
 
@@ -196,11 +200,29 @@ class Synchronizer:
         if address is not None:
             self.network.send(address, encode_sync_request(digest, self.name))
         task = asyncio.create_task(self._request_waiter(digest))
+        self._direct[digest] = task
         self._tasks.add(task)
         task.add_done_callback(self._tasks.discard)
 
     async def _request_waiter(self, digest: Digest) -> None:
-        await self.store.notify_read(digest.data)
+        try:
+            await self.store.notify_read(digest.data)
+        finally:
+            # Runs on fulfilment AND on cancel_request: either way the
+            # request entries must not outlive the waiter (a cancelled
+            # notify_read drops its store obligation in its own finally).
+            self._direct.pop(digest, None)
+            self._requests.pop(digest, None)
+            self._last_sent.pop(digest, None)
+
+    def cancel_request(self, digest: Digest) -> None:
+        """Withdraw a direct pull that will never be served (e.g. a
+        forged frontier digest from an unauthenticated state_response):
+        releases the retry entries, the waiter task, and — through the
+        waiter's cancellation — the store's notify_read obligation."""
+        task = self._direct.pop(digest, None)
+        if task is not None:
+            task.cancel()
         self._requests.pop(digest, None)
         self._last_sent.pop(digest, None)
 

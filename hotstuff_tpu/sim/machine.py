@@ -257,6 +257,14 @@ class SimSynchronizer:
             ("sched", self.sync_retry_delay, ("sync_retry", digest))
         )
 
+    def cancel_request(self, digest) -> None:
+        """Mirror of ``Synchronizer.cancel_request``: withdraw a direct
+        pull that will never be served. The pending ``sync_retry`` effect
+        self-cancels (``retry`` checks ``_requests`` membership); blocks
+        suspended on the digest (if any) stay registered — only the
+        request driving the network retries is withdrawn."""
+        self._requests.pop(digest, None)
+
     async def get_parent_block(self, block: Block):
         if block.qc == QC.genesis():
             return Block.genesis()

@@ -178,6 +178,11 @@ class LogEngine:
         self._replay()
         self._log = open(self._log_path, "ab")
         self._metalog = MetaLog(path)
+        # Writes arriving while a compaction rewrite is in flight are
+        # mirrored here and appended to the tmp file at commit, so the
+        # atomic replace never discards records the index already holds.
+        self._compacting = False
+        self._delta: list[tuple[bytes, bytes]] = []
 
     def put_meta(self, key: bytes, value: bytes, sync: bool = False) -> None:
         self._metalog.put(key, value, sync=sync)
@@ -209,37 +214,130 @@ class LogEngine:
         self._log.write(_HDR.pack(len(key), len(value)) + key + value)
         self._log.flush()
         self._index[key] = value
+        if self._compacting:
+            self._delta.append((key, value))
 
     def get(self, key: bytes) -> bytes | None:
         return self._index.get(key)
 
-    def compact(self, drop_keys) -> int:
-        """Rewrite ``store.log`` without ``drop_keys`` (and without superseded
-        duplicate records), atomically: tmp + fsync + ``os.replace`` +
-        directory fsync, same crash discipline as ``MetaLog._compact``. A
-        crash at any point leaves either the old complete log or the new
-        complete log. Unknown keys are retained conservatively. Returns the
-        number of bytes reclaimed (0 if the rewrite grew the file, which
-        cannot happen in practice since dropped + superseded records only
-        shrink it)."""
+    # -- phased compaction ---------------------------------------------------
+    #
+    # Rewrite ``store.log`` without the dropped keys (and without superseded
+    # duplicate records), atomically: tmp + fsync + ``os.replace`` +
+    # directory fsync, same crash discipline as ``MetaLog._compact``. A
+    # crash at any point leaves either the old complete log or the new
+    # complete log.
+    #
+    # Split into begin/write/commit so the expensive part — writing the
+    # retained records plus two fsyncs — can run OFF the event loop
+    # (``Store.compact`` sends it to an executor): a synchronous rewrite
+    # inside the commit path stalled consensus for the full copy, long
+    # enough at large stores to push nodes into view changes. ``begin``
+    # snapshots the index on the loop (reference copies, cheap) and arms
+    # the write mirror; ``write`` touches only its state object, so it is
+    # safe on any thread; ``commit`` appends the mirrored delta (small),
+    # swaps the files, and restores a usable append handle on EVERY path —
+    # a failed replace or reopen must never leave ``put`` with a closed
+    # handle.
+
+    class _CompactState:
+        __slots__ = ("items", "drop", "tmp", "error")
+
+        def __init__(self, items, drop, tmp):
+            self.items = items
+            self.drop = drop
+            self.tmp = tmp
+            self.error: OSError | None = None
+
+    def compact_begin(self, drop_keys) -> "_CompactState | None":
+        """Snapshot the retained records; ``None`` if a compaction is
+        already in flight (the caller retries at the next trigger)."""
+        if self._compacting:
+            return None
         drop = set(drop_keys)
-        tmp = self._log_path + ".tmp"
-        before = os.path.getsize(self._log_path) if os.path.exists(self._log_path) else 0
-        with open(tmp, "wb") as f:
-            for k, v in self._index.items():
-                if k in drop:
-                    continue
-                f.write(_HDR.pack(len(k), len(v)) + k + v)
-            f.flush()
-            os.fsync(f.fileno())
-        self._log.close()
-        os.replace(tmp, self._log_path)
-        self._fsync_dir()
-        self._log = open(self._log_path, "ab")
-        for k in drop:
+        items = [(k, v) for k, v in self._index.items() if k not in drop]
+        self._compacting = True
+        self._delta = []
+        return self._CompactState(items, drop, self._log_path + ".tmp")
+
+    def compact_write(self, state) -> bool:
+        """Write the retained snapshot to the tmp file (flush + fsync).
+        Reads only ``state`` — safe to run on an executor thread while the
+        loop keeps appending to the live log."""
+        try:
+            with open(state.tmp, "wb") as f:
+                for k, v in state.items:
+                    f.write(_HDR.pack(len(k), len(v)) + k + v)
+                f.flush()
+                os.fsync(f.fileno())
+            return True
+        except OSError as e:
+            state.error = e
+            return False
+
+    def compact_abort(self, state) -> None:
+        """Discard an in-flight compaction (write failure or shutdown):
+        the live log was never touched, so dropping the tmp file and the
+        mirror restores the pre-compaction world exactly."""
+        self._compacting = False
+        self._delta = []
+        try:
+            os.unlink(state.tmp)
+        except OSError:
+            pass
+
+    def compact_commit(self, state) -> int:
+        """Append the delta mirrored during the rewrite, atomically swap
+        the logs, and drop the dead keys from the index. Returns bytes
+        reclaimed. On ANY failure the engine is left with an open append
+        handle on whichever log file survived."""
+        before = (
+            os.path.getsize(self._log_path)
+            if os.path.exists(self._log_path)
+            else 0
+        )
+        replaced = False
+        try:
+            with open(state.tmp, "ab") as f:
+                for k, v in self._delta:
+                    if k in state.drop:
+                        continue
+                    f.write(_HDR.pack(len(k), len(v)) + k + v)
+                f.flush()
+                os.fsync(f.fileno())
+            self._log.close()
+            os.replace(state.tmp, self._log_path)
+            replaced = True
+            self._fsync_dir()
+        finally:
+            self._compacting = False
+            self._delta = []
+            if not replaced:
+                try:
+                    os.unlink(state.tmp)
+                except OSError:
+                    pass
+            if self._log.closed:
+                # Reopen whatever log is live: the new one after a
+                # successful replace, the old (intact) one otherwise.
+                self._log = open(self._log_path, "ab")
+        for k in state.drop:
             self._index.pop(k, None)
         after = os.path.getsize(self._log_path)
         return max(0, before - after)
+
+    def compact(self, drop_keys) -> int:
+        """Synchronous convenience wrapper over the phases (tests, tools).
+        Unknown keys are retained conservatively. Returns bytes reclaimed
+        (0 if a compaction was already in flight or the rewrite failed —
+        the old log stays live either way)."""
+        state = self.compact_begin(drop_keys)
+        if state is None:
+            return 0
+        if not self.compact_write(state):
+            self.compact_abort(state)
+            return 0
+        return self.compact_commit(state)
 
     def _fsync_dir(self) -> None:
         self._metalog._fsync_dir()
@@ -325,14 +423,51 @@ class Store:
     async def read_meta(self, key: bytes) -> bytes | None:
         return self._engine.get_meta(key)
 
+    def compaction_offloaded(self) -> bool:
+        """True when this store's engine runs the compaction rewrite off
+        the event loop (the phased protocol below) — callers may then run
+        ``compact`` as a background task; sync-only engines (the sim
+        plane's MemEngine) should be awaited inline instead."""
+        return hasattr(self._engine, "compact_begin")
+
     async def compact(self, drop_keys) -> int:
         """Drop ``drop_keys`` from the data log and reclaim their space
-        (engines without compaction support — e.g. the native engine — are a
-        no-op). Returns bytes reclaimed."""
-        engine_compact = getattr(self._engine, "compact", None)
-        if engine_compact is None:
-            return 0
-        return engine_compact(drop_keys)
+        (engines without compaction support are a no-op). Returns bytes
+        reclaimed.
+
+        Engines exposing the phased protocol (``compact_begin`` /
+        ``compact_write`` / ``compact_commit``) run the bulk rewrite —
+        the full retained-log copy plus its fsyncs — on the default
+        executor, so the event loop (votes, timeouts) keeps running while
+        the file is written; only the brief begin (index snapshot) and
+        commit (delta append + atomic swap) run on the loop. Concurrent
+        ``write``s during the rewrite are safe: the engine mirrors them
+        into the tmp file at commit. Engines with only a synchronous
+        ``compact`` (MemEngine: in-memory pops; the sim plane, which has
+        no executor) run inline as before."""
+        engine = self._engine
+        begin = getattr(engine, "compact_begin", None)
+        if begin is None:
+            engine_compact = getattr(engine, "compact", None)
+            if engine_compact is None:
+                return 0
+            return engine_compact(drop_keys)
+        state = begin(drop_keys)
+        if state is None:
+            return 0  # a compaction is already in flight
+        loop = asyncio.get_running_loop()
+        fut = loop.run_in_executor(None, engine.compact_write, state)
+        try:
+            ok = await asyncio.shield(fut)
+        except asyncio.CancelledError:
+            # The rewrite thread cannot be interrupted: let it finish,
+            # then discard its output — the live log was never touched.
+            fut.add_done_callback(lambda _f: engine.compact_abort(state))
+            raise
+        if not ok:
+            engine.compact_abort(state)
+            raise StoreError(f"compaction rewrite failed: {state.error}")
+        return engine.compact_commit(state)
 
     async def notify_read(self, key: bytes) -> bytes:
         """Return the value for ``key``, waiting for a future ``write`` if it

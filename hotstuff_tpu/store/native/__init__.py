@@ -73,6 +73,18 @@ def _load():
             ctypes.c_char_p,
             ctypes.c_uint64,
         ]
+        lib.hs_store_compact_begin.restype = ctypes.c_void_p
+        lib.hs_store_compact_begin.argtypes = [
+            ctypes.c_void_p,
+            ctypes.c_char_p,
+            ctypes.c_uint64,
+        ]
+        lib.hs_store_compact_write.restype = ctypes.c_int
+        lib.hs_store_compact_write.argtypes = [ctypes.c_void_p]
+        lib.hs_store_compact_abort.restype = None
+        lib.hs_store_compact_abort.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
+        lib.hs_store_compact_commit.restype = ctypes.c_int64
+        lib.hs_store_compact_commit.argtypes = [ctypes.c_void_p, ctypes.c_void_p]
         lib.hs_store_close.restype = None
         lib.hs_store_close.argtypes = [ctypes.c_void_p]
         _lib = lib
@@ -109,19 +121,61 @@ class NativeEngine:
             raise OSError("native store read failed")
         return buf.raw
 
-    def compact(self, drop_keys) -> int:
-        """Drop ``drop_keys`` from the log and reclaim their space (atomic
-        rewrite, same crash discipline as ``LogEngine.compact``). Returns
-        bytes reclaimed."""
+    # -- phased compaction (see LogEngine for the contract) ---------------
+    #
+    # ``compact_begin`` (loop thread) deep-copies the retained records in
+    # C and arms the put-delta mirror; ``compact_write`` touches only that
+    # state, so Store.compact runs it on an executor thread — ctypes
+    # releases the GIL for the call, so the rewrite runs truly concurrent
+    # with the event loop; ``compact_commit`` (loop thread) appends the
+    # mirrored delta, swaps the files, and restores the append handle.
+
+    class _CompactState:
+        __slots__ = ("ptr", "error")
+
+        def __init__(self, ptr) -> None:
+            self.ptr = ptr
+            self.error = None
+
+    def compact_begin(self, drop_keys) -> "_CompactState | None":
         import struct
 
         blob = b"".join(
             struct.pack("<I", len(k)) + bytes(k) for k in drop_keys
         )
-        freed = self._lib.hs_store_compact(self._handle, blob, len(blob))
+        ptr = self._lib.hs_store_compact_begin(self._handle, blob, len(blob))
+        if not ptr:
+            return None  # compaction already in flight (or malformed set)
+        return self._CompactState(ptr)
+
+    def compact_write(self, state) -> bool:
+        ok = self._lib.hs_store_compact_write(state.ptr) == 0
+        if not ok:
+            state.error = "native tmp rewrite failed"
+        return ok
+
+    def compact_abort(self, state) -> None:
+        self._lib.hs_store_compact_abort(self._handle, state.ptr)
+        state.ptr = None
+
+    def compact_commit(self, state) -> int:
+        freed = self._lib.hs_store_compact_commit(self._handle, state.ptr)
+        state.ptr = None  # commit consumed (and freed) the state either way
         if freed < 0:
             raise OSError("native store compaction failed")
         return int(freed)
+
+    def compact(self, drop_keys) -> int:
+        """Drop ``drop_keys`` from the log and reclaim their space (atomic
+        rewrite, same crash discipline as ``LogEngine.compact``). Returns
+        bytes reclaimed; 0 if a compaction was already in flight."""
+        state = self.compact_begin(drop_keys)
+        if state is None:
+            return 0
+        if not self.compact_write(state):
+            self.compact_abort(state)
+            raise OSError("native store compaction failed")
+        return self.compact_commit(state)
 
     def size_bytes(self) -> int:
         try:

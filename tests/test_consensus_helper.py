@@ -72,3 +72,44 @@ async def test_helper_ignores_unknown_digest_and_stranger():
     await rx.put((sha512_digest(b"unknown"), stranger))  # unknown requestor
     await rx.put((sha512_digest(b"unknown"), keys()[1][0]))  # unknown block
     await asyncio.sleep(0.2)  # nothing to assert beyond "no crash/no send"
+
+
+@async_test
+async def test_helper_rate_limits_snapshot_replies_per_origin():
+    """Regression: the request's origin field is unsigned and spoofable,
+    and a snapshot reply is heavy (two blocks + a 2f+1-signature QC) —
+    spraying unknown digests with a victim's origin must not have the
+    helper amplify traffic at the victim. At most one snapshot reply per
+    origin per half retry window, checked BEFORE the meta read."""
+    from hotstuff_tpu.consensus.statesync import SNAPSHOT_KEY, encode_snapshot
+
+    committee = consensus_committee(BASE + 30)
+    blocks = chain(4)
+    snapshot = encode_snapshot(blocks[1], blocks[2], blocks[3].qc)
+
+    class _CountingStore(Store):
+        def __init__(self):
+            super().__init__()
+            self.meta_reads = 0
+
+        async def read_meta(self, key):
+            self.meta_reads += 1
+            return await super().read_meta(key)
+
+    store = _CountingStore()
+    await store.write_meta(SNAPSHOT_KEY, snapshot)
+    rx: asyncio.Queue = asyncio.Queue()
+    # sync_retry_delay=10s -> 5s window: the burst below fits inside it.
+    Helper.spawn(committee, store, rx, sync_retry_delay=10_000)
+    from hotstuff_tpu.crypto import sha512_digest
+
+    victim = keys()[1][0]
+    for i in range(5):
+        await rx.put((sha512_digest(b"unknown%d" % i), victim))
+    await asyncio.sleep(0.2)
+    assert store.meta_reads == 1  # one snapshot reply, 4 requests shed
+    # A different origin is NOT throttled by the victim's bucket.
+    other = keys()[2][0]
+    await rx.put((sha512_digest(b"unknown-other"), other))
+    await asyncio.sleep(0.1)
+    assert store.meta_reads == 2

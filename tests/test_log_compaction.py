@@ -5,6 +5,7 @@ interacting with the snapshot record (ISSUE 16 satellite)."""
 
 from __future__ import annotations
 
+import asyncio
 import os
 import struct
 
@@ -41,6 +42,72 @@ def test_log_compact_squeezes_superseded_duplicates(tmp_path):
     assert freed > 0
     assert eng.get(b"hot") == b"x" * 100
     eng.close()
+
+
+def test_log_phased_compaction_mirrors_concurrent_puts(tmp_path):
+    # Regression: the rewrite runs off the event loop (Store.compact sends
+    # compact_write to an executor), so puts can land while it is in
+    # flight. They must be mirrored into the tmp file at commit or the
+    # atomic replace silently discards records the index already holds.
+    eng = LogEngine(str(tmp_path))
+    _fill(eng, n=20)
+    drop = [b"k%04d" % i for i in range(10)]
+    state = eng.compact_begin(drop)
+    assert state is not None
+    assert eng.compact_begin(drop) is None  # one compaction at a time
+    eng.put(b"mid", b"written-during-rewrite")
+    assert eng.compact_write(state)
+    eng.put(b"late", b"written-after-rewrite-before-commit")
+    assert eng.compact_commit(state) > 0
+    assert eng.get(b"mid") == b"written-during-rewrite"
+    assert eng.get(b"late") == b"written-after-rewrite-before-commit"
+    assert eng.get(b"k0003") is None and eng.get(b"k0015") is not None
+    eng.close()
+    # The mirrored records must be IN the swapped file, not only the index.
+    eng2 = LogEngine(str(tmp_path))
+    assert eng2.get(b"mid") == b"written-during-rewrite"
+    assert eng2.get(b"late") == b"written-after-rewrite-before-commit"
+    assert eng2.get(b"k0003") is None and eng2.get(b"k0015") is not None
+    eng2.close()
+
+
+def test_log_compact_commit_failure_restores_append_handle(tmp_path, monkeypatch):
+    # Regression: a failed atomic swap used to leave the engine with a
+    # closed append handle, poisoning every later put. The old log must
+    # stay live and writable after the failure.
+    eng = LogEngine(str(tmp_path))
+    _fill(eng, n=10)
+    state = eng.compact_begin([b"k0000"])
+    assert eng.compact_write(state)
+    monkeypatch.setattr(os, "replace", lambda src, dst: (_ for _ in ()).throw(OSError("no swap")))
+    with pytest.raises(OSError):
+        eng.compact_commit(state)
+    monkeypatch.undo()
+    eng.put(b"after-failure", b"v")
+    assert eng.get(b"after-failure") == b"v"
+    eng.close()
+    eng2 = LogEngine(str(tmp_path))
+    assert eng2.get(b"after-failure") == b"v"
+    assert eng2.get(b"k0000") is not None  # old log survived whole
+    eng2.close()
+
+
+@async_test
+async def test_store_compact_offloaded_with_concurrent_writes(tmp_path):
+    # Store.compact runs the rewrite on the executor; a write racing it on
+    # the loop must survive the swap.
+    store = Store(engine=LogEngine(str(tmp_path)))
+    for i in range(50):
+        await store.write(b"k%04d" % i, bytes([i % 256]) * 64)
+    assert store.compaction_offloaded()
+    task = asyncio.create_task(store.compact([b"k%04d" % i for i in range(40)]))
+    await asyncio.sleep(0)  # let the rewrite reach the executor
+    await store.write(b"mid-compaction", b"v")
+    assert await task > 0
+    assert await store.read(b"mid-compaction") == b"v"
+    assert await store.read(b"k0001") is None
+    assert await store.read(b"k0045") is not None
+    store.close()
 
 
 def test_log_compact_survives_reopen(tmp_path):
@@ -97,6 +164,35 @@ def test_native_engine_compact_parity(tmp_path):
     # engines stay interchangeable on disk across a truncation.
     pyeng = LogEngine(str(tmp_path))
     assert pyeng.get(b"k0000") is None and pyeng.get(b"k0025") is not None
+    pyeng.close()
+
+
+def test_native_engine_phased_compaction_mirrors_puts(tmp_path):
+    native = pytest.importorskip("hotstuff_tpu.store.native")
+    try:
+        eng = native.NativeEngine(str(tmp_path))
+    except Exception:
+        pytest.skip("native toolchain unavailable")
+    _fill(eng, n=20)
+    drop = [b"k%04d" % i for i in range(10)]
+    state = eng.compact_begin(drop)
+    assert state is not None
+    assert eng.compact_begin(drop) is None  # one compaction at a time
+    eng.put(b"mid", b"written-during-rewrite")
+    assert eng.compact_write(state)
+    eng.put(b"late", b"written-after-rewrite-before-commit")
+    assert eng.compact_commit(state) > 0
+    eng.put(b"after", b"post-swap-append")  # handle restored by commit
+    assert eng.get(b"mid") == b"written-during-rewrite"
+    assert eng.get(b"k0003") is None and eng.get(b"k0015") is not None
+    eng.close()
+    # The mirrored records are IN the swapped file (replay via LogEngine:
+    # same on-disk format, independent reader).
+    pyeng = LogEngine(str(tmp_path))
+    assert pyeng.get(b"mid") == b"written-during-rewrite"
+    assert pyeng.get(b"late") == b"written-after-rewrite-before-commit"
+    assert pyeng.get(b"after") == b"post-swap-append"
+    assert pyeng.get(b"k0003") is None and pyeng.get(b"k0015") is not None
     pyeng.close()
 
 

@@ -14,12 +14,13 @@ from hotstuff_tpu.consensus.statesync import (
     Compactor,
     Snapshot,
     SnapshotError,
+    StateSync,
     decode_snapshot,
     encode_snapshot,
     peek_frontier,
     verify_snapshot,
 )
-from hotstuff_tpu.crypto import Signature
+from hotstuff_tpu.crypto import Digest, Signature
 from hotstuff_tpu.store import Store
 
 from .common import async_test, chain, consensus_committee, keys
@@ -45,18 +46,17 @@ def _proof(n: int = 6, k: int = 2):
 
 def test_snapshot_roundtrip_and_peek():
     _, frontier, child, cert = _proof()
-    raw = encode_snapshot(frontier, child, cert, last_voted_round=7)
+    raw = encode_snapshot(frontier, child, cert)
     assert peek_frontier(raw) == (frontier.round, frontier.digest())
     snap = decode_snapshot(raw)
     assert snap.frontier.digest() == frontier.digest()
     assert snap.child.digest() == child.digest()
     assert snap.cert.hash == cert.hash and snap.cert.round == cert.round
-    assert snap.last_voted_round == 7
 
 
 def test_snapshot_rejects_unknown_version():
     _, frontier, child, cert = _proof()
-    raw = encode_snapshot(frontier, child, cert, 0)
+    raw = encode_snapshot(frontier, child, cert)
     with pytest.raises(SnapshotError):
         decode_snapshot(b"\xff" + raw[1:])
     with pytest.raises(SnapshotError):
@@ -65,7 +65,7 @@ def test_snapshot_rejects_unknown_version():
 
 def test_snapshot_rejects_truncated_record():
     _, frontier, child, cert = _proof()
-    raw = encode_snapshot(frontier, child, cert, 0)
+    raw = encode_snapshot(frontier, child, cert)
     with pytest.raises(SnapshotError):
         decode_snapshot(raw[: len(raw) // 2])
     with pytest.raises(SnapshotError):
@@ -77,8 +77,8 @@ def test_snapshot_rejects_header_frontier_mismatch():
     # Swap the frontier block for a different one while keeping the header:
     # peek_frontier answers from the header, so the full decode must verify
     # the header actually matches the embedded block.
-    honest = encode_snapshot(frontier, child, cert, 0)
-    forged = encode_snapshot(blocks[0], child, cert, 0)
+    honest = encode_snapshot(frontier, child, cert)
+    forged = encode_snapshot(blocks[0], child, cert)
     # Splice honest header (ver + u64 round + 32B digest) onto forged body.
     with pytest.raises(SnapshotError):
         decode_snapshot(honest[:41] + forged[41:])
@@ -89,20 +89,20 @@ def test_snapshot_rejects_nonconsecutive_child():
     # blocks[4].qc certifies blocks[3], not blocks[2]: child does not
     # certify the claimed frontier.
     with pytest.raises(SnapshotError):
-        decode_snapshot(encode_snapshot(blocks[2], blocks[4], blocks[5].qc, 0))
+        decode_snapshot(encode_snapshot(blocks[2], blocks[4], blocks[5].qc))
 
 
 def test_snapshot_rejects_cert_for_wrong_block():
     blocks = chain(6)
     # cert certifies blocks[4], not the child blocks[3].
     with pytest.raises(SnapshotError):
-        decode_snapshot(encode_snapshot(blocks[2], blocks[3], blocks[5].qc, 0))
+        decode_snapshot(encode_snapshot(blocks[2], blocks[3], blocks[5].qc))
 
 
 def test_snapshot_rejects_genesis_frontier():
     blocks = chain(3)
-    fake = Snapshot(blocks[0], blocks[1], blocks[2].qc, 0)
-    raw = encode_snapshot(fake.frontier, fake.child, fake.cert, 0)
+    fake = Snapshot(blocks[0], blocks[1], blocks[2].qc)
+    raw = encode_snapshot(fake.frontier, fake.child, fake.cert)
     # Round-1 frontier is fine; a genesis (round-0) frontier can't exist in
     # a well-formed record because Block round 0 is the genesis sentinel —
     # assert decode of the valid boundary still works.
@@ -115,7 +115,7 @@ def test_snapshot_rejects_genesis_frontier():
 @async_test
 async def test_verify_snapshot_accepts_honest_proof():
     _, frontier, child, cert = _proof()
-    raw = encode_snapshot(frontier, child, cert, 0)
+    raw = encode_snapshot(frontier, child, cert)
     committee = consensus_committee(9300)
     await verify_snapshot(decode_snapshot(raw), committee)
 
@@ -132,7 +132,7 @@ async def test_verify_snapshot_rejects_forged_cert_votes():
         round=cert.round,
         votes=[(pk, Signature.new(cert.digest(), wrong_sk)) for pk, _ in key_list],
     )
-    raw = encode_snapshot(frontier, child, forged, 0)
+    raw = encode_snapshot(frontier, child, forged)
     committee = consensus_committee(9310)
     with pytest.raises(Exception):
         await verify_snapshot(decode_snapshot(raw), committee)
@@ -142,10 +142,9 @@ async def test_verify_snapshot_rejects_forged_cert_votes():
 
 
 class _CoreStub:
-    def __init__(self, store, last_committed_round, last_voted_round=0):
+    def __init__(self, store, last_committed_round):
         self.store = store
         self.last_committed_round = last_committed_round
-        self.last_voted_round = last_voted_round
         self.synchronizer = self
 
     def note_floor(self, frontier):
@@ -163,6 +162,7 @@ async def test_compactor_truncates_below_frontier(tmp_path):
         comp.note_commit(b)
     core = _CoreStub(store, last_committed_round=18)
     await comp.maybe_compact(core)
+    await comp.drain()  # the log rewrite runs as a background task
     raw = await store.read_meta(SNAPSHOT_KEY)
     assert raw is not None, "snapshot record must be written"
     snap = decode_snapshot(raw)
@@ -189,6 +189,7 @@ async def test_compactor_hysteresis_no_op_below_threshold(tmp_path):
         comp.note_commit(b)
     # head - snapshot(0) = 10 < 2*8: must not snapshot yet.
     await comp.maybe_compact(_CoreStub(store, last_committed_round=10))
+    await comp.drain()
     assert await store.read_meta(SNAPSHOT_KEY) is None
     store.close()
 
@@ -204,6 +205,7 @@ async def test_compactor_snapshot_survives_reopen(tmp_path):
     for b in blocks:
         comp.note_commit(b)
     await comp.maybe_compact(_CoreStub(store, last_committed_round=18))
+    await comp.drain()
     raw = await store.read_meta(SNAPSHOT_KEY)
     store.close()
     store2 = Store(path)
@@ -214,6 +216,201 @@ async def test_compactor_snapshot_survives_reopen(tmp_path):
         if b.round < snap.frontier.round:
             assert await store2.read(b.digest().data) is None
     store2.close()
+
+
+# -- StateSync install: only certified state is adopted ----------------------
+
+
+class _InstallCore:
+    """Minimal core surface ``StateSync._install`` touches, recording what
+    the snapshot makes it adopt."""
+
+    def __init__(self):
+        self.store = Store()  # MemEngine
+        self.synchronizer = self
+        self.last_committed_round = 0
+        self._last_committed_digest = None
+        self.last_voted_round = 0
+        self.qcs = []
+        self.persists = 0
+        self.cached = []
+
+    def note_floor(self, frontier):
+        self.floor = frontier
+
+    def cache_block(self, block):
+        self.cached.append(block)
+
+    def increase_last_voted_round(self, target):
+        self.last_voted_round = max(self.last_voted_round, target)
+
+    async def process_qc(self, qc):
+        self.qcs.append(qc)
+
+    async def _persist_state(self):
+        self.persists += 1
+
+
+@async_test
+async def test_install_adopts_only_certified_voting_floor():
+    # Regression: v1 records carried the creator's last_voted_round as an
+    # unauthenticated hint; a byzantine peer attaching 2^64-1 to a valid
+    # proof would permanently mute the installer (block.round can never
+    # exceed it again). The record must carry no such field, and _install
+    # must raise the voting floor only to the round the certificates
+    # prove — c1's.
+    _, frontier, child, cert = _proof()
+    raw = encode_snapshot(frontier, child, cert)
+    snap = decode_snapshot(raw)
+    assert not hasattr(snap, "last_voted_round")
+
+    ss = StateSync(keys()[0][0], consensus_committee(9320), 100)
+    core = _InstallCore()
+    ss._core = core
+    await ss._install(snap, raw)
+
+    assert core.last_voted_round == child.round
+    assert core.floor.digest() == frontier.digest()
+    assert core.last_committed_round == frontier.round
+    assert [(q.hash, q.round) for q in core.qcs] == [(cert.hash, cert.round)]
+    assert core.persists == 1
+    assert await core.store.read_meta(SNAPSHOT_KEY) == raw
+    assert await core.store.read(frontier.digest().data) == frontier.serialize()
+    assert await core.store.read(child.digest().data) == child.serialize()
+
+
+# -- StateSync pull cap: forged frontier claims are O(1) ---------------------
+
+
+class _PullSync:
+    def __init__(self):
+        self.requests = []
+        self.cancelled = []
+        self.outstanding = set()
+
+    def request_block(self, digest, address):
+        self.requests.append(digest)
+        self.outstanding.add(digest)
+
+    def requested(self, digest):
+        return digest in self.outstanding
+
+    def cancel_request(self, digest):
+        self.cancelled.append(digest)
+        self.outstanding.discard(digest)
+
+
+class _PullCore:
+    def __init__(self):
+        self.synchronizer = _PullSync()
+        self.last_committed_round = 0
+        self.network = self
+        self.scheduled = []
+
+    def _call_later(self, delay, item):
+        self.scheduled.append(item)
+
+    def send(self, address, data):
+        pass
+
+
+@async_test
+async def test_forged_frontier_spray_bounded_to_one_pull():
+    # Regression: the (round, digest) claim in a state_response is
+    # unauthenticated. A byzantine peer spraying distinct forged digests
+    # must not grow a request entry + store obligation + waiter task per
+    # response — at most ONE direct pull may be in flight.
+    ss = StateSync(keys()[0][0], consensus_committee(9330), 100)
+    core = _PullCore()
+    ss._core = core
+    sync = core.synchronizer
+    for i in range(8):
+        await ss.handle_state_response((50 + i, Digest(bytes([i]) * 32), None))
+    assert len(sync.requests) == 1
+
+
+@async_test
+async def test_pull_ttl_evicts_unservable_digest():
+    ss = StateSync(keys()[0][0], consensus_committee(9340), 100)
+    core = _PullCore()
+    ss._core = core
+    sync = core.synchronizer
+    bogus = Digest(b"\x0b" * 32)
+    await ss.handle_state_response((50, bogus, None))
+    assert sync.requests == [bogus]
+    # No peer ever serves it: after PULL_TTL_TICKS the slot is evicted via
+    # cancel_request (releasing the synchronizer bookkeeping) ...
+    for _ in range(StateSync.PULL_TTL_TICKS):
+        await ss.handle_tick()
+    assert sync.cancelled == [bogus]
+    assert ss._pull is None
+    # ... and a later (honest) claim can use the slot again.
+    honest = Digest(b"\xaa" * 32)
+    await ss.handle_state_response((60, honest, None))
+    assert sync.requests == [bogus, honest]
+
+
+@async_test
+async def test_pull_slot_frees_on_resolution_without_cancel():
+    ss = StateSync(keys()[0][0], consensus_committee(9350), 100)
+    core = _PullCore()
+    ss._core = core
+    sync = core.synchronizer
+    first = Digest(b"\x01" * 32)
+    await ss.handle_state_response((50, first, None))
+    sync.outstanding.discard(first)  # the block arrived: request resolved
+    await ss.handle_tick()
+    assert ss._pull is None and sync.cancelled == []
+    second = Digest(b"\x02" * 32)
+    await ss.handle_state_response((60, second, None))
+    assert sync.requests == [first, second]
+
+
+# -- StateSync server: snapshot replies rate-limited per origin --------------
+
+
+class _CountingStore:
+    def __init__(self, snapshot):
+        self._snapshot = snapshot
+        self.meta_reads = 0
+
+    async def read_meta(self, key):
+        self.meta_reads += 1
+        return self._snapshot
+
+
+class _ServeCore:
+    def __init__(self, store, frontier_digest):
+        self.store = store
+        self.last_committed_round = 30
+        self._last_committed_digest = frontier_digest
+        self.network = self
+        self.sent = []
+
+    def send(self, address, data):
+        self.sent.append(data)
+
+
+@async_test
+async def test_state_request_snapshot_rate_limited_per_origin():
+    # Regression: the request's origin field is unsigned and spoofable,
+    # and the snapshot record is heavy — a spray of forged requests must
+    # not amplify snapshot traffic at the accused origin (at most one
+    # attachment per origin per tick; plain frontier replies still flow).
+    _, frontier, child, cert = _proof()
+    raw = encode_snapshot(frontier, child, cert)
+    ss = StateSync(keys()[0][0], consensus_committee(9360), 100)
+    core = _ServeCore(_CountingStore(raw), frontier.digest())
+    ss._core = core
+    origin = keys()[1][0]
+    await ss.handle_state_request((0, origin))
+    await ss.handle_state_request((0, origin))
+    await ss.handle_state_request((0, origin))
+    assert core.store.meta_reads == 1  # snapshot attached once this tick
+    assert len(core.sent) == 3  # every request still gets a frontier reply
+    ss._tick_no += 1  # next probe window
+    await ss.handle_state_request((0, origin))
+    assert core.store.meta_reads == 2
 
 
 # -- frontier-availability checker ------------------------------------------

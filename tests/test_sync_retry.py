@@ -78,6 +78,45 @@ async def test_idle_loop_never_touches_the_network():
 
 
 @async_test(timeout=30)
+async def test_cancel_request_releases_waiter_and_store_obligation():
+    # Regression: direct pulls (state-sync frontier requests) are driven
+    # by unauthenticated peer claims, so the caller must be able to
+    # withdraw one that will never resolve — without leaking the retry
+    # entries, the waiter task, or the store's notify_read obligation.
+    from hotstuff_tpu.store import Store
+
+    committee = consensus_committee(BASE + 80)
+    store = Store()
+    s = Synchronizer(
+        committee.sorted_keys()[0], committee, store, asyncio.Queue(), 5_000
+    )
+    s.network = type(
+        "Rec", (), {"send": lambda self, a, d: None,
+                    "broadcast": lambda self, addrs, d: None},
+    )()
+    try:
+        bogus = chain(1)[0].digest()
+        s.request_block(bogus, None)
+        assert s.requested(bogus)
+        await asyncio.sleep(0)  # waiter reaches notify_read
+        assert store._obligations
+        s.cancel_request(bogus)
+        await asyncio.sleep(0)  # cancellation unwinds the waiter
+        assert not s.requested(bogus)
+        assert not s._direct and not s._last_sent
+        assert not store._obligations
+        # The slot is genuinely free: the same digest can be re-requested.
+        s.request_block(bogus, None)
+        assert s.requested(bogus)
+        # Fulfilment self-cleans the same entries without a cancel.
+        await store.write(bogus.data, b"block-bytes")
+        await asyncio.sleep(0)
+        assert not s.requested(bogus) and not s._direct
+    finally:
+        s.shutdown()
+
+
+@async_test(timeout=30)
 async def test_suspend_timestamps_come_from_injected_clock():
     committee = consensus_committee(BASE + 50)
     from hotstuff_tpu.store import Store
