@@ -33,6 +33,8 @@ import os
 import sys
 import time
 
+from benchmark.hostinfo import host_meta
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 
@@ -283,6 +285,7 @@ def run_faults(args) -> None:
     }
     report = {
         "verdict": verdict,
+        "host": host_meta(),
         # None (not true) when --replay didn't run: absence of evidence.
         "replay_trace_match": (
             traces[0] == traces[1] if len(traces) == 2 else None

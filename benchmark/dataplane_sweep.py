@@ -33,6 +33,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
 from benchmark.local import BenchError, LocalBench  # noqa: E402
 from benchmark.logs import ParseError  # noqa: E402
 
@@ -70,6 +71,7 @@ def run_point(
     max_batch_delay: int,
     timeout: int,
     dtrace: bool = False,
+    client_extra: list[str] | None = None,
 ) -> dict:
     bench = LocalBench(
         nodes=nodes,
@@ -83,6 +85,7 @@ def run_point(
         work_dir=work_dir,
         workers=workers,
         telemetry=dtrace,
+        client_extra=client_extra,
     )
     parser = bench.run()
     e2e_tps, e2e_bps, dur = parser._end_to_end_throughput()
@@ -159,6 +162,12 @@ def main() -> None:
     p.add_argument("--work-dir", default=".dataplane-bench")
     p.add_argument("--output", help="directory for the sweep artifact")
     p.add_argument(
+        "--client-extra", default="",
+        help="extra args appended to every client command line, e.g. "
+        "'--coalesce-bytes 8192 --coalesce-ms 5' to enable small-bundle "
+        "write coalescing",
+    )
+    p.add_argument(
         "--dtrace", action="store_true",
         help="stream telemetry from every node and attach the assembled "
         "per-batch lifeline attribution (seven-edge) to each point; also "
@@ -198,6 +207,7 @@ def main() -> None:
                 max_batch_delay=args.max_batch_delay,
                 timeout=args.timeout,
                 dtrace=args.dtrace,
+                client_extra=args.client_extra.split() or None,
             )
         except (BenchError, ParseError) as e:
             row = {"rate": rate, "error": str(e)}
@@ -220,6 +230,7 @@ def main() -> None:
     report = {
         "schema": SWEEP_SCHEMA,
         "ts": time.time(),
+        "host": host_meta(),
         "config": {
             "nodes": args.nodes,
             "workers": args.workers,
@@ -227,6 +238,7 @@ def main() -> None:
             "duration_s": args.duration,
             "batch_size": args.batch_size,
             "max_batch_delay_ms": args.max_batch_delay,
+            "client_extra": args.client_extra or None,
         },
         "rows": rows,
         "peak": peak,
@@ -303,6 +315,7 @@ def main() -> None:
                 json.dump(
                     {
                         "config": report["config"],
+                        "host": report["host"],
                         "rate": peak["rate"],
                         "lifeline": peak["dtrace"],
                     },

@@ -47,6 +47,7 @@ import time
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
 from benchmark.watchtower import DirectoryWatch  # noqa: E402
 
 BENCH_SCHEMA = "hotstuff-watchtower-detect-v1"
@@ -401,6 +402,7 @@ def main() -> None:
 
     report = {
         "schema": BENCH_SCHEMA,
+        "host": host_meta(),
         "ok": not problems,
         "config": {
             "nodes": args.nodes,

@@ -47,6 +47,7 @@ from statistics import median
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
 from benchmark.logs import ParseError, _to_posix, read_stream_records  # noqa: E402
 from benchmark.trace_assemble import (  # noqa: E402
     _pct,
@@ -355,6 +356,7 @@ def assemble(
     )
     report = {
         "schema": REPORT_SCHEMA,
+        "host": host_meta(),
         "streams": [os.path.basename(p) for p in paths],
         "events": len(devents),
         "round_trace_rounds": len(rounds),

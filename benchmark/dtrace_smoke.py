@@ -43,6 +43,8 @@ import sys
 import tempfile
 import time
 
+from benchmark.hostinfo import host_meta
+
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 #: the edges the micro data plane can close (no consensus in the loop:
@@ -380,6 +382,7 @@ def main() -> None:
     overhead = statistics.median(overheads)
     result = {
         "metric": f"dtrace_overhead_p{args.batches}x{args.repeats}",
+        "host": host_meta(),
         "off_cpu_ms_per_batch": round(statistics.median(off_ms), 3),
         "overhead": round(overhead, 4),
         "leg_overheads": [round(o, 4) for o in overheads],

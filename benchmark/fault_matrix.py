@@ -28,6 +28,8 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
+
 
 def build_scenarios(n: int, duration: float):
     """The three acceptance scenarios, parameterized by committee size
@@ -160,7 +162,7 @@ def main() -> None:
         json.dump(run_plane(args), sys.stdout)
         return
 
-    report: dict[str, dict] = {"nodes": args.nodes, "planes": {}}
+    report: dict = {"nodes": args.nodes, "host": host_meta(), "planes": {}}
     ok = True
     for plane in args.planes.split(","):
         env = dict(os.environ)

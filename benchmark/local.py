@@ -57,6 +57,7 @@ class LocalBench:
         chaos: str | None = None,
         workers: int = 0,
         retention_rounds: int = 0,
+        client_extra: list[str] | None = None,
     ) -> None:
         self.nodes = nodes
         self.rate = rate
@@ -87,6 +88,9 @@ class LocalBench:
         # Lazarus: snapshot/truncate retention depth in rounds (0 =
         # unbounded store, the historic behavior).
         self.retention_rounds = retention_rounds
+        # Extra argv appended to every client (e.g. ``--fleet``/
+        # ``--coalesce-bytes`` knobs from the fleet/sweep harnesses).
+        self.client_extra = list(client_extra or [])
         self._procs: list[subprocess.Popen] = []
         self._node_procs: dict[int, subprocess.Popen] = {}
         self._node_cmds: dict[int, tuple[list, str]] = {}  # i -> (cmd, log)
@@ -245,6 +249,7 @@ class LocalBench:
                             "--timeout",
                             str(self.timeout_delay),
                             *shard_args,
+                            *self.client_extra,
                             "--nodes",
                             *node_addrs,
                         ],

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import glob
 import json
+import math
 import os
 from datetime import datetime
 from re import findall, search
@@ -75,10 +76,16 @@ class LogParser:
         self.committee_size = len(nodes) + faults
 
         results = [self._parse_client(log) for log in clients]
-        self.sizes_cfg, self.rate, self.start, misses, self.sent_samples = zip(
-            *results
-        )
+        (
+            self.sizes_cfg,
+            self.rate,
+            self.start,
+            misses,
+            self.sent_samples,
+            sheds,
+        ) = zip(*results)
         self.misses = sum(misses)
+        self.sheds = sum(sheds)
 
         results = [self._parse_node(log) for log in nodes]
         proposals, commits, sizes, received, timeouts, self.configs = zip(*results)
@@ -106,7 +113,10 @@ class LogParser:
             int(s): _to_posix(t)
             for t, s in findall(r"\[(.*Z) .* sample transaction (\d+)", log)
         }
-        return size, rate, start, misses, samples
+        # Cumulative counter: the last line per client is its total.
+        shed_lines = findall(r"Shed notifications: (\d+)", log)
+        sheds = int(shed_lines[-1]) if shed_lines else 0
+        return size, rate, start, misses, samples, sheds
 
     def _parse_node(self, log: str):
         if search(r"Traceback|panic", log) is not None:
@@ -182,13 +192,27 @@ class LogParser:
         tps = bps / self.sizes_cfg[0]
         return tps, bps, duration
 
-    def _end_to_end_latency(self):
+    def _e2e_latency_samples(self) -> list[float]:
         lat = []
         for sent, received in zip(self.sent_samples, self.received_samples):
             for tx_id, batch_id in received.items():
                 if batch_id in self.commits and tx_id in sent:
                     lat.append(self.commits[batch_id] - sent[tx_id])
+        return lat
+
+    def _end_to_end_latency(self):
+        lat = self._e2e_latency_samples()
         return mean(lat) if lat else 0
+
+    def e2e_latency_tail(self, q: float) -> float:
+        """Order-statistic percentile (q in (0,1]) of sample-tx e2e
+        latency in seconds. With one sample per 50 ms burst a p99.9
+        needs a multi-minute run to be meaningful; shorter runs degrade
+        toward the max, which is still the honest tail bound."""
+        lat = sorted(self._e2e_latency_samples())
+        if not lat:
+            return 0.0
+        return lat[max(0, math.ceil(q * len(lat)) - 1)]
 
     def result(self) -> str:
         consensus_latency = self._consensus_latency() * 1000
@@ -225,6 +249,11 @@ class LogParser:
             f" End-to-end TPS: {round(e2e_tps):,} tx/s\n"
             f" End-to-end BPS: {round(e2e_bps):,} B/s\n"
             f" End-to-end latency: {round(e2e_latency):,} ms\n"
+            f" End-to-end latency p99: "
+            f"{round(self.e2e_latency_tail(0.99) * 1000):,} ms\n"
+            f" End-to-end latency p99.9: "
+            f"{round(self.e2e_latency_tail(0.999) * 1000):,} ms\n"
+            f" Shed notifications: {self.sheds:,}\n"
             "-----------------------------------------\n"
         )
 

@@ -26,6 +26,7 @@ import json
 import sys
 import time
 
+from benchmark.hostinfo import host_meta
 from hotstuff_tpu.network.receiver import MessageHandler, Receiver
 from hotstuff_tpu.network.reliable_sender import ReliableSender
 
@@ -84,6 +85,7 @@ async def _run_one(transport: str, size: int, frames: int, window: int,
     elapsed = time.perf_counter() - t0
     result = {
         "transport": transport,
+        "host": host_meta(),
         "size": size,
         "frames": frames,
         "window": window,

@@ -33,6 +33,7 @@ from collections import Counter, defaultdict
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
 from benchmark.logs import ParseError, read_stream_records  # noqa: E402
 from benchmark.trace_assemble import EDGES, assemble  # noqa: E402
 
@@ -166,6 +167,7 @@ def attribute(
         }
     return {
         "schema": ATTRIBUTION_SCHEMA,
+        "host": host_meta(),
         "streams": trace_report["streams"],
         "skipped_streams": sorted(
             set(skipped) | set(trace_report["skipped_streams"])

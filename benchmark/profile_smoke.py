@@ -34,6 +34,8 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
+
 
 def _run_once(
     n: int,
@@ -201,6 +203,7 @@ def main() -> None:
 
     result = {
         "metric": f"pyprof_overhead_n{args.nodes}",
+        "host": host_meta(),
         "off_ms_per_round": round(best_off * 1e3, 2),
         "on_ms_per_round": round(best_on * 1e3, 2),
         "overhead": round(overhead, 4),

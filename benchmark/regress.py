@@ -39,6 +39,8 @@ import time
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+from benchmark.hostinfo import host_meta  # noqa: E402
+
 REGRESS_SCHEMA = "hotstuff-regress-v1"
 
 _PROTOCOL_LINE = re.compile(
@@ -192,6 +194,16 @@ def main() -> None:
     p.add_argument("--dataplane-workers", type=int, default=1)
     p.add_argument("--dataplane-duration", type=int, default=15)
     p.add_argument(
+        "--dataplane-parity", type=int, default=0, metavar="RATE",
+        help="paired small-frame legs at this offered rate: one asyncio, "
+        "one native (subprocesses inherit HOTSTUFF_NET per leg); fails "
+        "when native e2e TPS drops below asyncio's minus the tolerance",
+    )
+    p.add_argument(
+        "--parity-size", type=int, default=1024,
+        help="tx size (B) for the --dataplane-parity legs",
+    )
+    p.add_argument(
         "--pyprof", action="store_true",
         help="sample the protocol measurement and attach the top "
         "self-time functions to the artifact (a red gate then names "
@@ -200,7 +212,12 @@ def main() -> None:
     p.add_argument("--output", help="directory for the JSON artifact")
     args = p.parse_args()
 
-    if args.skip_protocol and args.skip_crypto and not args.dataplane:
+    if (
+        args.skip_protocol
+        and args.skip_crypto
+        and not args.dataplane
+        and not args.dataplane_parity
+    ):
         print("nothing to check", file=sys.stderr)
         sys.exit(2)
 
@@ -302,10 +319,63 @@ def main() -> None:
             )
         checks.append(check)
 
+    if args.dataplane_parity:
+        from benchmark.dataplane_sweep import run_point
+
+        # Same offered load through both transports, back to back on the
+        # same host. The bench subprocesses read HOTSTUFF_NET from the
+        # inherited environment, so each leg swaps the whole plane —
+        # receiver, senders, and worker ingress — not just the parent.
+        legs: dict[str, dict] = {}
+        for i, plane in enumerate(("asyncio", "native")):
+            saved = os.environ.get("HOTSTUFF_NET")
+            os.environ["HOTSTUFF_NET"] = plane
+            try:
+                legs[plane] = run_point(
+                    args.dataplane_parity,
+                    nodes=4,
+                    workers=args.dataplane_workers,
+                    tx_size=args.parity_size,
+                    duration=args.dataplane_duration,
+                    base_port=args.base_port + 7_000 + i * 1_000,
+                    work_dir=f".regress-parity-{plane}",
+                    batch_size=250_000,
+                    max_batch_delay=50,
+                    timeout=5_000,
+                )
+            finally:
+                if saved is None:
+                    os.environ.pop("HOTSTUFF_NET", None)
+                else:
+                    os.environ["HOTSTUFF_NET"] = saved
+        floor = legs["asyncio"]["e2e_tps"] * (1 - args.tolerance)
+        checks.append(
+            {
+                "metric": (
+                    f"dataplane_parity_tps_{args.parity_size}B"
+                    f"_{args.dataplane_parity}offered"
+                ),
+                "status": "compared",
+                "fresh": legs["native"]["e2e_tps"],
+                "baseline": legs["asyncio"]["e2e_tps"],
+                "baseline_source": "paired asyncio leg (same run)",
+                "floor": round(floor),
+                "ratio": round(
+                    legs["native"]["e2e_tps"]
+                    / max(legs["asyncio"]["e2e_tps"], 1),
+                    3,
+                ),
+                "native_latency_ms": legs["native"]["e2e_latency_ms"],
+                "asyncio_latency_ms": legs["asyncio"]["e2e_latency_ms"],
+                "ok": legs["native"]["e2e_tps"] >= floor,
+            }
+        )
+
     ok = all(c["ok"] for c in checks)
     report = {
         "schema": REGRESS_SCHEMA,
         "ok": ok,
+        "host": host_meta(),
         "tolerance": args.tolerance,
         "ts": time.time(),
         "checks": checks,

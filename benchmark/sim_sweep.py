@@ -28,6 +28,7 @@ import logging
 import sys
 import time
 
+from benchmark.hostinfo import host_meta
 from hotstuff_tpu.faultline.policy import Scenario, chaos_scenario
 from hotstuff_tpu.sim import SimWorld
 from hotstuff_tpu.sim.shrink import shrink, sim_failure_probe, write_reproducer
@@ -211,6 +212,7 @@ def main(argv=None) -> int:
     per_min = n_runs / wall * 60.0 if wall > 0 else 0.0
     summary = {
         "schema": SCHEMA,
+        "host": host_meta(),
         "config": {
             "seeds": [lo, hi],
             "nodes": args.nodes,

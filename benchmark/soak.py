@@ -33,6 +33,7 @@ import sys
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
 from benchmark.local import LocalBench  # noqa: E402
 from benchmark.logs import ParseError, TelemetryParser, read_telemetry_stream  # noqa: E402
 from benchmark.watchtower import DirectoryWatch  # noqa: E402
@@ -304,6 +305,7 @@ def run_soak(args) -> dict:
     return {
         "schema": SOAK_SCHEMA,
         "ok": ok,
+        "host": host_meta(),
         "config": {
             "nodes": args.nodes,
             "rate": args.rate,

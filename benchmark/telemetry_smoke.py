@@ -33,6 +33,8 @@ import tempfile
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
+
 
 def _run_once(
     n: int, rounds: int, base_port: int, with_telemetry: bool, snap_path: str | None
@@ -136,6 +138,7 @@ def main() -> None:
 
     result = {
         "metric": f"telemetry_overhead_n{args.nodes}",
+        "host": host_meta(),
         "off_ms_per_round": round(best_off * 1e3, 2),
         "on_ms_per_round": round(best_on * 1e3, 2),
         "overhead": round(overhead, 4),

@@ -47,6 +47,7 @@ from statistics import median
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
+from benchmark.hostinfo import host_meta  # noqa: E402
 from benchmark.logs import ParseError, read_stream_records  # noqa: E402
 
 REPORT_SCHEMA = "hotstuff-trace-critical-path-v1"
@@ -324,6 +325,7 @@ def assemble(
     rounds = assemble_rounds(events, offsets)
     report = {
         "schema": REPORT_SCHEMA,
+        "host": host_meta(),
         "streams": [os.path.basename(p) for p in paths],
         "events": len(events),
         "skipped_streams": sorted(set(skipped)),

@@ -73,6 +73,12 @@ _EV_RECV = 1
 _EV_ACKED = 2
 _EV_GONE = 3
 _EV_VOTE_BATCH = 4
+_EV_RECV_BATCH = 5
+
+# EV_RECV_BATCH payload record header: u64 LE conn_id | u32 LE frame len
+# (followed by the frame bytes). One batch event carries every frame a
+# listener's connections produced during one C++ poll cycle.
+_BATCH_REC = struct.Struct("<QI")
 
 # Command-ring record layouts (hs_net_cmds_flush). Little-endian, fixed
 # headers; see netcore.cpp for the authoritative spec.
@@ -225,6 +231,11 @@ STATS_FIELDS = (
     "writev_calls", "send_drops", "faults_dropped", "faults_delayed",
     "loop_polls", "poll_ns", "dispatch_ns",
     "cmds_serviced", "cmd_service_ns", "cmd_service_max_ns",
+    # Batched-ingress account — dotted names so the stats collector
+    # surfaces them as net.native.ingress.* gauges (docs/telemetry.md):
+    # reads = recv() syscalls, frames = frames via EV_RECV_BATCH,
+    # batches = batch events (frames/batches = frames per wakeup).
+    "ingress.reads", "ingress.frames", "ingress.batches",
 )
 
 # Rate limit for the loop-side drop warnings (satellite: silent filtering
@@ -728,6 +739,10 @@ class NativeTransport:
                     receiver = self._listeners.get(a)
                     if receiver is not None:
                         receiver._enqueue(b, payload)
+                elif etype == _EV_RECV_BATCH:
+                    receiver = self._listeners.get(a)
+                    if receiver is not None:
+                        receiver._enqueue_frames(b, payload)
                 elif etype == _EV_VOTE_BATCH:
                     receiver = self._listeners.get(a)
                     if receiver is not None:
@@ -780,7 +795,13 @@ class NativeReceiver:
     With a vote pre-stage configured (``configure_vote_prestage``), the
     C++ loop delivers pre-validated votes as aggregated batches; the
     dispatch task hands each batch to ``handler.dispatch_votes`` (falling
-    back to per-frame ``dispatch`` for handlers without one)."""
+    back to per-frame ``dispatch`` for handlers without one).
+
+    General inbound frames arrive the same way (EV_RECV_BATCH: one
+    aggregated event per poll cycle, conn ids preserved per record); a
+    handler exposing ``dispatch_frames(pairs)`` receives the whole
+    ``[(writer, frame), ...]`` list per wakeup, others degrade to
+    per-frame ``dispatch``."""
 
     def __init__(
         self, address: tuple[str, int], handler, auto_ack: bool = False
@@ -814,6 +835,12 @@ class NativeReceiver:
 
     def _enqueue_votes(self, count: int, packed: bytes) -> None:
         self._queue.put_nowait(("votes", count, packed))
+
+    def _enqueue_frames(self, count: int, packed: bytes) -> None:
+        """One poll cycle's aggregated general-ingress frames
+        (EV_RECV_BATCH): ``packed`` is ``count`` records of
+        ``[u64 conn_id | u32 len | frame]``. One queue put per cycle."""
+        self._queue.put_nowait(("frames", count, packed))
 
     def configure_vote_prestage(self, authors: list[bytes]) -> None:
         """Enable the C++ vote pre-stage with the committee's 32-byte
@@ -859,6 +886,61 @@ class NativeReceiver:
                         self.address,
                     )
                 undisclosed += len(frames)
+                continue
+            if kind == "frames":
+                # Aggregated general ingress: decode the cycle's records,
+                # hand the handler the whole list per wakeup
+                # (``dispatch_frames``; per-frame ``dispatch`` fallback).
+                batch: list[tuple[int, bytes]] = []
+                off = 0
+                end = len(payload)
+                while off + 12 <= end:
+                    cid, flen = _BATCH_REC.unpack_from(payload, off)
+                    off += 12
+                    batch.append((cid, payload[off : off + flen]))
+                    off += flen
+                plane = _faultline.plane
+                if plane is not None:
+                    kept: list[tuple[int, bytes]] = []
+                    for cid, frame in batch:
+                        plan = plane.filter_recv(self.address)
+                        if plan is not None:
+                            f_action, f_delay = plan
+                            if f_delay > 0:
+                                await asyncio.sleep(f_delay)
+                            if f_action == "drop":
+                                continue
+                        kept.append((cid, frame))
+                    batch = kept
+                if batch:
+                    writers: dict[int, object] = {}
+                    pairs = []
+                    for cid, frame in batch:
+                        if self.auto_ack:
+                            writer = acked
+                        else:
+                            writer = writers.get(cid)
+                            if writer is None:
+                                writer = writers[cid] = _NativeFramedWriter(
+                                    self._transport, cid
+                                )
+                        pairs.append((writer, frame))
+                    dispatch_frames = getattr(
+                        self.handler, "dispatch_frames", None
+                    )
+                    try:
+                        if dispatch_frames is not None:
+                            await dispatch_frames(pairs)
+                        else:
+                            for writer, frame in pairs:
+                                await self.handler.dispatch(writer, frame)
+                    except Exception:
+                        log.exception(
+                            "frame batch handler error (native receiver %s)",
+                            self.address,
+                        )
+                # The C++ budget charged every frame, dropped or not.
+                undisclosed += a
                 continue
             conn_id = a
             # Faultline ingress filter (``side: "recv"`` rules). The C++

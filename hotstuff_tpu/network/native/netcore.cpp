@@ -97,6 +97,14 @@ enum : uint8_t {
   // (the vote pre-stage): ONE Python wakeup per poll cycle for the whole
   // fan-in, not one per frame.
   EV_VOTE_BATCH = 4,
+  // a=listener_id, b=frame count, payload=count records of
+  //   [u64 LE conn_id | u32 LE len | len bytes]
+  // — the general-ingress form of the vote pre-stage: every frame a
+  // listener's connections produced during one poll cycle rides ONE
+  // aggregated event, so the Python side pays one wakeup + one queue put
+  // per cycle instead of one per frame (the small-frame ingress floor,
+  // ROADMAP item 3 / PR 14's residual `ingress_wait`).
+  EV_RECV_BATCH = 5,
 };
 
 // Fixed wire layout of a consensus Vote (consensus/messages.py):
@@ -172,6 +180,14 @@ struct StatsReq {
   uint64_t cmds_serviced = 0;       // commands drained by run_commands
   uint64_t cmd_service_ns = 0;      // sum of enqueue->service latency
   uint64_t cmd_service_max_ns = 0;  // worst single command latency
+  // Batched-ingress account (net.native.ingress.* in the catalog):
+  // reads = successful recv() syscalls on inbound conns, frames = frames
+  // delivered via EV_RECV_BATCH, batches = EV_RECV_BATCH events emitted.
+  // frames/batches is the frames-per-wakeup coalescing factor;
+  // frames/reads the parse yield per syscall.
+  uint64_t ingress_reads = 0;
+  uint64_t ingress_frames = 0;
+  uint64_t ingress_batches = 0;
 };
 
 struct Command {
@@ -256,7 +272,19 @@ struct Listener {
   // ONE EV_VOTE_BATCH per cycle.
   std::string vote_buf;
   uint64_t vote_count = 0;
+
+  // General inbound frames accumulated during the current poll cycle,
+  // flushed as ONE EV_RECV_BATCH per cycle (records carry the conn_id so
+  // reply channels survive aggregation).
+  std::string ingress_buf;
+  uint64_t ingress_count = 0;
 };
+
+// A single EV_RECV_BATCH payload is flushed early past this size so the
+// Python drain buffer doesn't have to grow toward the per-cycle inbound
+// bound (conns x READ_BATCH_CAP); the event stays "one per cycle" in the
+// common case and degrades to a handful under extreme bulk.
+constexpr size_t INGRESS_FLUSH_CAP = 2u * 1024u * 1024u;
 
 // Test-only per-peer fault injection (hs_net_faults): chaos scenarios
 // must also exercise the native egress path (broadcast coalescing, the
@@ -509,6 +537,7 @@ class NetCore {
         }
       }
       flush_vote_batches();
+      flush_ingress_batches();
       flush_delayed_frames(now);
       // Reconnect timers: disconnected reliable connections redial on
       // their backoff schedule whether or not traffic is queued (the
@@ -745,6 +774,9 @@ class NetCore {
           s->cmds_serviced = cmds_serviced_;
           s->cmd_service_ns = cmd_service_ns_;
           s->cmd_service_max_ns = cmd_service_max_ns_;
+          s->ingress_reads = ingress_reads_;
+          s->ingress_frames = ingress_frames_;
+          s->ingress_batches = ingress_batches_;
           {
             // notify under the lock: after the unlock the waiter may
             // (spurious wakeup) observe done and destroy the
@@ -847,6 +879,7 @@ class NetCore {
           c.inbuf.resize(old + size_t(r));
           got += size_t(r);
           bytes_rx_ += uint64_t(r);
+          ingress_reads_++;
         } else {
           c.inbuf.resize(old);
           if (r == 0 || (errno != EAGAIN && errno != EWOULDBLOCK))
@@ -877,6 +910,20 @@ class NetCore {
         if (l != nullptr && l->vf_enabled && len == VOTE_WIRE_LEN &&
             uint8_t(c.inbuf[off + 4]) == VOTE_TAG) {
           charge = prestage_vote(*l, c.inbuf.data() + off + 4);
+        } else if (l != nullptr) {
+          // Accumulate into the listener's per-cycle batch instead of
+          // emitting per frame: the whole cycle's ingress costs Python
+          // one wakeup (flush_ingress_batches, same shape as votes).
+          char rec[12];
+          memcpy(rec, &id, 8);        // u64 LE conn_id (header struct <QI)
+          memcpy(rec + 8, &len, 4);   // u32 LE frame length
+          l->ingress_buf.append(rec, 12);
+          l->ingress_buf.append(c.inbuf.data() + off + 4, len);
+          l->ingress_count++;
+          ingress_frames_++;
+          if (l->ingress_buf.size() >= INGRESS_FLUSH_CAP) {
+            flush_ingress(c.listener_id, *l);
+          }
         } else {
           emit(Event{EV_RECV, c.listener_id, id,
                      c.inbuf.substr(off + 4, len)});
@@ -952,6 +999,21 @@ class NetCore {
       l.vote_buf.clear();  // moved-from: reset to a known state
       l.vote_count = 0;
     }
+  }
+
+  void flush_ingress(uint64_t lid, Listener& l) {
+    if (l.ingress_count == 0) return;
+    ingress_batches_++;
+    emit(Event{EV_RECV_BATCH, lid, l.ingress_count,
+               std::move(l.ingress_buf)});
+    l.ingress_buf.clear();  // moved-from: reset to a known state
+    l.ingress_count = 0;
+  }
+
+  // The general-ingress mirror of flush_vote_batches: every frame parsed
+  // this cycle reaches Python as one aggregated event per listener.
+  void flush_ingress_batches() {
+    for (auto& [lid, l] : listeners_) flush_ingress(lid, l);
   }
 
   void flush_inbound(InConn& c) {
@@ -1431,6 +1493,9 @@ class NetCore {
   uint64_t cmds_serviced_ = 0;
   uint64_t cmd_service_ns_ = 0;
   uint64_t cmd_service_max_ns_ = 0;
+  uint64_t ingress_reads_ = 0;  // batched-ingress account (loop thread)
+  uint64_t ingress_frames_ = 0;
+  uint64_t ingress_batches_ = 0;
 
   std::unordered_map<uint64_t, Listener> listeners_;  // loop thread only
   std::unordered_map<uint64_t, InConn> in_conns_;
@@ -1716,13 +1781,13 @@ void hs_net_stats(void* ctx, uint64_t* out) {
 //  votes_dropped, votes_dropped_dup, frames_rx, bytes_rx, frames_tx,
 //  bytes_tx, writev_calls, send_drops, faults_dropped, faults_delayed,
 //  loop_polls, poll_ns, dispatch_ns, cmds_serviced, cmd_service_ns,
-//  cmd_service_max_ns}
+//  cmd_service_max_ns, ingress_reads, ingress_frames, ingress_batches}
 // and returns the number filled (new fields append, existing indices
 // never move — callers probe the return value instead of pinning a
 // struct version). Same loop-thread servicing — and the same
 // no-race-with-destroy contract — as hs_net_stats.
 int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
-  constexpr int N_FIELDS = 22;
+  constexpr int N_FIELDS = 25;
   if (out == nullptr || cap <= 0) return 0;
   StatsReq req;
   Command c;
@@ -1742,7 +1807,8 @@ int hs_net_stats_ex(void* ctx, uint64_t* out, int cap) {
       req.writev_calls,  req.send_drops,   req.faults_dropped,
       req.faults_delayed, req.loop_polls,  req.poll_ns,
       req.dispatch_ns,   req.cmds_serviced, req.cmd_service_ns,
-      req.cmd_service_max_ns,
+      req.cmd_service_max_ns, req.ingress_reads, req.ingress_frames,
+      req.ingress_batches,
   };
   int n = cap < N_FIELDS ? cap : N_FIELDS;
   for (int i = 0; i < n; i++) out[i] = fields[i];

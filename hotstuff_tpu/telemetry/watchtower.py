@@ -325,6 +325,7 @@ class Watchtower:
         self._sync_state: dict[str, tuple] = {}
         # Conveyor worker health per stream node (latest snapshot wins).
         self._worker_stats: dict[str, dict] = {}
+        self._ingress_peak: dict[str, float] = {}
         self._meta: dict[str, dict] = {}
 
     # -- ingestion -----------------------------------------------------------
@@ -483,11 +484,19 @@ class Watchtower:
             ("mempool.worker.certs_formed", "certs_formed"),
             ("mempool.worker.throttle_events", "throttle_events"),
             ("mempool.resolver.unresolved", "resolver_unresolved"),
+            ("net.native.ingress.reads", "ingress_reads"),
+            ("net.native.ingress.frames", "ingress_frames"),
+            ("net.native.ingress.batches", "ingress_batches"),
         ):
             v = counters.get(key)
             if isinstance(v, (int, float)):
                 worker[label] = v
         if worker:
+            depth = worker.get("ingress_depth")
+            if isinstance(depth, (int, float)):
+                self._ingress_peak[node] = max(
+                    self._ingress_peak.get(node, 0.0), depth
+                )
             self._worker_stats[node] = worker
         fired += self._check_digest_queue(node, snap, gauges, ts)
         fired += self._check_sync_stall(node, snap, gauges, ts)
@@ -1031,7 +1040,51 @@ class Watchtower:
                 node: dict(stats)
                 for node, stats in sorted(self._worker_stats.items())
             }
+        backlog = self.ingress_backlog()
+        if backlog:
+            result["ingress_backlog"] = backlog
         return result
+
+    def ingress_backlog(self) -> dict:
+        """Per-node ingress batching health from the
+        ``net.native.ingress.*`` counters and the worker depth gauge:
+        how many frames each socket read and each wakeup carried, plus
+        the deepest the worker queue has been across the stream's
+        snapshots. ``frames_per_wakeup`` near 1.0 under load means the
+        transport regressed to the one-frame-per-wakeup floor the
+        batched ingress path exists to remove; a rising ``depth_peak``
+        with flat ``shed_tx`` is backlog building before the shed
+        threshold bites."""
+        view: dict[str, dict] = {}
+        for node, stats in sorted(self._worker_stats.items()):
+            reads = stats.get("ingress_reads")
+            frames = stats.get("ingress_frames")
+            batches = stats.get("ingress_batches")
+            depth = stats.get("ingress_depth")
+            peak = self._ingress_peak.get(node)
+            if not any(
+                isinstance(v, (int, float))
+                for v in (reads, frames, batches, depth)
+            ):
+                continue
+            entry: dict[str, float | None] = {
+                "reads": reads,
+                "frames": frames,
+                "batches": batches,
+                "depth": depth,
+                "depth_peak": peak,
+                "shed_tx": stats.get("shed_tx"),
+                "frames_per_read": (
+                    round(frames / reads, 3) if reads and frames else None
+                ),
+                "frames_per_wakeup": (
+                    round(frames / batches, 3)
+                    if batches and frames
+                    else None
+                ),
+            }
+            view[node] = entry
+        return view
 
 
 class AlertCapture:

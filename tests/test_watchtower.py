@@ -630,3 +630,68 @@ def test_scoreboard_surfaces_dataplane_worker_stats():
     wt2 = Watchtower(config=WatchtowerConfig())
     wt2.ingest_record({**snap, "counters": {}, "gauges": {}}, source="n1")
     assert "dataplane" not in wt2.scoreboard()
+
+
+def test_ingress_backlog_view_derives_batching_ratios():
+    """The `ingress_backlog` view folds the net.native.ingress.*
+    counters and the worker depth gauge into per-node batching ratios,
+    and tracks the depth high-water mark across snapshots."""
+    wt = Watchtower(config=WatchtowerConfig())
+    base = {
+        "schema": "hotstuff-telemetry-v1",
+        "node": "n1",
+        "pid": 7,
+        "final": False,
+        "histograms": {},
+    }
+    wt.ingest_record(
+        {
+            **base,
+            "seq": 0,
+            "ts": 1.0,
+            "counters": {
+                "net.native.ingress.reads": 40,
+                "net.native.ingress.frames": 400,
+                "net.native.ingress.batches": 50,
+                "mempool.worker.shed_tx": 0,
+            },
+            "gauges": {"mempool.worker.ingress_depth": 96},
+        },
+        source="n1",
+    )
+    # Later snapshot: counters advanced, depth drained — the peak must
+    # remember the earlier high-water mark.
+    wt.ingest_record(
+        {
+            **base,
+            "seq": 1,
+            "ts": 2.0,
+            "counters": {
+                "net.native.ingress.reads": 100,
+                "net.native.ingress.frames": 800,
+                "net.native.ingress.batches": 100,
+                "mempool.worker.shed_tx": 3,
+            },
+            "gauges": {"mempool.worker.ingress_depth": 4},
+        },
+        source="n1",
+    )
+    view = wt.ingress_backlog()
+    assert view["n1"]["reads"] == 100
+    assert view["n1"]["frames"] == 800
+    assert view["n1"]["frames_per_read"] == 8.0
+    assert view["n1"]["frames_per_wakeup"] == 8.0
+    assert view["n1"]["depth"] == 4
+    assert view["n1"]["depth_peak"] == 96
+    assert view["n1"]["shed_tx"] == 3
+    # The scoreboard carries the same view for harness verdicts.
+    board = wt.scoreboard()
+    assert board["ingress_backlog"]["n1"]["frames_per_wakeup"] == 8.0
+    # A stream with only protocol metrics yields no backlog view.
+    wt2 = Watchtower(config=WatchtowerConfig())
+    wt2.ingest_record(
+        {**base, "seq": 0, "ts": 1.0, "counters": {}, "gauges": {}},
+        source="n1",
+    )
+    assert wt2.ingress_backlog() == {}
+    assert "ingress_backlog" not in wt2.scoreboard()
