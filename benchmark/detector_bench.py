@@ -57,10 +57,21 @@ BENCH_SCHEMA = "hotstuff-watchtower-detect-v1"
 #: precision — a laggard alert on a crashed node is correct evidence).
 EXPECTED_DETECTORS = {
     "crash": ("laggard", "silent_voter", "partitioned_clique"),
+    # partitioned_clique is expected for byzantine too: a silent leader
+    # (or vote-withholding actor) stops appearing in anyone's committing
+    # set, which the clique detector reports as a singleton component —
+    # correct peer, correct window, same rationale as laggard.
     "byzantine": (
         "grinding_leader", "silent_voter", "equivocation", "laggard",
+        "partitioned_clique",
     ),
-    "partition": ("partitioned_clique", "silent_voter", "laggard"),
+    # grinding_leader is expected for partition for the same reason it
+    # is for link: an isolated node is alive-but-unseen — its own
+    # stream keeps reporting timeouts while no proposal of its ever
+    # reaches an observer, which is exactly the silent-leader shape.
+    "partition": (
+        "partitioned_clique", "silent_voter", "laggard", "grinding_leader",
+    ),
     "link": (
         "grinding_leader", "partitioned_clique", "silent_voter", "laggard",
     ),
@@ -274,7 +285,10 @@ def run_labeled(
 
 def main() -> None:
     from hotstuff_tpu.faultline import Scenario, chaos_scenario
-    from hotstuff_tpu.telemetry.watchtower import WatchtowerConfig
+    from hotstuff_tpu.telemetry.watchtower import (
+        DETECTOR_CATALOG_VERSION,
+        WatchtowerConfig,
+    )
 
     p = argparse.ArgumentParser(description=__doc__)
     p.add_argument(
@@ -400,16 +414,19 @@ def main() -> None:
                 f"{[(a['detector'], a['accused']) for a in r['alerts']]}"
             )
 
+    effective_config = config or WatchtowerConfig()
     report = {
         "schema": BENCH_SCHEMA,
         "host": host_meta(),
         "ok": not problems,
+        "detector_catalog": DETECTOR_CATALOG_VERSION,
         "config": {
             "nodes": args.nodes,
             "duration_s": args.duration,
             "timeout_ms": args.timeout,
             "slack_s": args.slack,
-            "watchtower": (config or WatchtowerConfig()).__dict__,
+            "watchtower": effective_config.__dict__,
+            "watchtower_hash": effective_config.fingerprint(),
         },
         "runs": runs,
         "controls": controls,

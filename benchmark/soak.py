@@ -89,11 +89,22 @@ def run_soak(args) -> dict:
             os.path.join(work_dir, "captures"),
             profile_s=0.0,  # nodes are other processes: evidence-only
         )
+        watch_cfg = None
+        if args.watch_config:
+            if args.watch_config.startswith("preset:"):
+                watch_cfg = WatchtowerConfig.preset(
+                    args.watch_config.split(":", 1)[1]
+                )
+            else:
+                # Accept both a bare config dict and a committed preset
+                # document ({"schema": ..., "config": {...}, ...}).
+                doc = json.load(open(args.watch_config))
+                watch_cfg = WatchtowerConfig.from_dict(
+                    doc.get("config", doc) if isinstance(doc, dict) else doc
+                )
         watch = DirectoryWatch(
             logs_dir,
-            config=WatchtowerConfig.from_dict(
-                json.load(open(args.watch_config))
-            ) if args.watch_config else None,
+            config=watch_cfg,
             on_alert=capture,
             alerts_path=os.path.join(logs_dir, "watchtower-alerts.jsonl"),
         )
@@ -402,7 +413,10 @@ def main() -> None:
         help="disable the live watchtower (alerts section absent)",
     )
     p.add_argument(
-        "--watch-config", help="JSON WatchtowerConfig overrides",
+        "--watch-config",
+        help="WatchtowerConfig for the live tower: a JSON file (bare "
+        "config or committed preset document) or preset:<name> "
+        "(e.g. preset:tuned-n4, Oracle's sweep-tuned preset)",
     )
     p.add_argument(
         "--allow-violation-fraction", type=float, default=0.34,

@@ -106,11 +106,14 @@ class DirectoryWatch(threading.Thread):
                 )
 
     def _drain_all(self) -> int:
+        # One feed() per stream: the batch path the replay/sweep planes
+        # use, so live-follow and offline replay share the ingest loop.
         n = 0
         for path, follower in self._followers.items():
-            for record in follower.drain():
-                self.watch.ingest_record(record, source=path)
-                n += 1
+            batch = [(record, path) for record in follower.drain()]
+            if batch:
+                self.watch.feed(batch)
+                n += len(batch)
         return n
 
     def run(self) -> None:
@@ -198,8 +201,7 @@ def replay_directory(
                 ts = timed[-1][0] if timed else 0.0
             timed.append((ts, path, record))
     timed.sort(key=lambda x: x[0])
-    for _ts, path, record in timed:
-        watch.ingest_record(record, source=path)
+    watch.feed((record, path) for _ts, path, record in timed)
     watch.flush()
     return watch
 

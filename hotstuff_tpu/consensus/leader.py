@@ -214,6 +214,45 @@ class ReputationLeaderElector:
         return eligible[int.from_bytes(h[:8], "little") % len(eligible)]
 
 
+class ScheduledLeaderElector:
+    """A fixed ``{round: leader}`` override with round-robin fallback —
+    the per-round leader-assignment control of the Twins methodology
+    (Bano et al.): the adversary scripts exactly who leads each round,
+    instead of waiting for rotation to land where the attack needs it.
+
+    Strict like round-robin (the schedule is global and deterministic,
+    so all instances consulting it agree), stateless (``update`` /
+    ``note_round_entry`` are no-ops), and safe to share across the
+    simulated instances of one world. Not reachable from production
+    config on purpose: it exists for ``sim.twins`` adversary
+    enumeration, where ``SimWorld(leader_schedule=...)`` installs it.
+    """
+
+    lenient = False
+
+    def __init__(
+        self, committee: Committee, schedule: dict[Round, PublicKey]
+    ) -> None:
+        self.committee = committee
+        self._sorted = committee.sorted_keys()
+        self._schedule = dict(schedule)
+
+    def get_leader(self, round_: Round) -> PublicKey:
+        pk = self._schedule.get(round_)
+        if pk is not None:
+            return pk
+        return self._sorted[round_ % len(self._sorted)]
+
+    def update(self, block) -> None:
+        pass
+
+    def note_round_entry(self, round_: Round, via_tc: bool) -> None:
+        pass
+
+    def gate_active(self, round_: Round) -> bool:
+        return True
+
+
 def make_elector(committee: Committee, kind: str):
     if kind == "reputation":
         return ReputationLeaderElector(committee)

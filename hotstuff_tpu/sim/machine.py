@@ -52,6 +52,7 @@ from hotstuff_tpu.consensus.messages import (
     encode_propose,
     encode_state_response,
     encode_sync_request,
+    sha512_digest,
 )
 from hotstuff_tpu.consensus.statesync import (
     SNAPSHOT_KEY,
@@ -170,13 +171,34 @@ class _SimMempoolDriver(MempoolDriver):
     """Payload gate without the PayloadWaiter task: the sim plane has no
     mempool, so blocks carry empty payloads and missing payloads (only
     fabricatable by byzantine traffic) simply fail availability instead
-    of parking a waiter."""
+    of parking a waiter.
+
+    ``twin_salts`` (installed by a ``SimWorld(twin_proposal_salt=True)``
+    world) lists every instance's salt; a payload digest that matches
+    the deterministic per-(instance, round) salt digest is treated as
+    available without a store read. Twins runs model clients feeding
+    DIFFERENT batches to the two copies of a seat — availability is
+    universal by assumption there, digest divergence is the point — so
+    the gate must not veto what the safety checker exists to judge."""
+
+    twin_salts: tuple[bytes, ...] = ()
 
     async def verify(self, block) -> bool:
         for d in block.payload:
-            if await self.store.read(d.data) is None:
+            if await self.store.read(d.data) is None and not self._twin_salt_ok(
+                d, block.round
+            ):
                 return False
         return True
+
+    def _twin_salt_ok(self, digest, round_) -> bool:
+        if not self.twin_salts:
+            return False
+        rb = round_.to_bytes(8, "little")
+        return any(
+            digest == sha512_digest(b"twins-proposal-salt", salt, rb)
+            for salt in self.twin_salts
+        )
 
 
 class SimSynchronizer:
@@ -415,6 +437,9 @@ class CoreStateMachine:
         mempool_driver = _SimMempoolDriver(
             self.store, self.tx_mempool, self.rx_message
         )
+        # Handle for SimWorld: twin-salt worlds install the committee's
+        # salt list on it (see _SimMempoolDriver.twin_salts).
+        self.mempool_driver = mempool_driver
         self.core = _SimCore(
             name,
             committee,
@@ -453,6 +478,13 @@ class CoreStateMachine:
         self._handlers = self.core.bound_handlers()
         self._payload_buffer: set = set()
         self._signature_service = self.core.signature_service
+        # Oracle/Twins hooks, set post-construction by the world: a
+        # virtual-clock trace sink (sim.streams.SimRoundTrace) and a
+        # per-instance payload salt so a twin pair's same-round blocks
+        # differ by digest (real twins act on different client payloads;
+        # the sim has no clients, so the salt stands in).
+        self.trace = None
+        self.proposal_salt: bytes | None = None
 
     # -- scheduler-facing surface -----------------------------------------
 
@@ -525,6 +557,14 @@ class CoreStateMachine:
     def _make_block(self, make: ProposerMake) -> None:
         payload = sorted(self._payload_buffer, key=lambda d: d.data)
         self._payload_buffer.clear()
+        if self.proposal_salt is not None:
+            payload.append(
+                sha512_digest(
+                    b"twins-proposal-salt",
+                    self.proposal_salt,
+                    make.round.to_bytes(8, "little"),
+                )
+            )
         block = run_sync(
             Block.new(
                 make.qc,
@@ -538,6 +578,13 @@ class CoreStateMachine:
         addresses = [
             a for _, a in self.core.committee.broadcast_addresses(self.core.name)
         ]
+        if self.trace is not None:
+            # The real plane's leader-side broadcast mark (Proposer emits
+            # it via telemetry.trace_event): author + digest so stream
+            # analyzers attribute the proposal and spot conflicts.
+            self.trace.propose_send(
+                make.round, f"{self.core.name!r}|{block.digest()!r}"
+            )
         self.outbox.broadcast(addresses, encode_propose(block, self._wire_seats))
         self._effects.append(("sched", 0.0, ("loopback", block)))
 

@@ -19,13 +19,33 @@ Mapping onto this codebase:
   latency a window of W seconds covers ~10·W rounds, and the generator
   enumerates window phases so leader/partition alignments vary);
 - leader rotation comes from the deterministic round-robin elector
-  cycling every seat through leadership inside each window, rather than
-  the paper's explicit per-round leader assignment.
+  cycling every seat through leadership inside each window — OR, with
+  the per-round API below, from the paper's explicit controls:
+  ``SimWorld(leader_schedule=...)`` pins exactly who leads each round
+  (a :class:`~hotstuff_tpu.consensus.leader.ScheduledLeaderElector`
+  shared by every instance) and ``SimWorld(round_partitions=...)``
+  decides per-message connectivity by the SENDER's current round, so a
+  partition arrangement holds for protocol rounds rather than wall
+  windows. ``twin_proposal_salt`` makes a twin pair's same-round blocks
+  differ by digest (payloads are salted per instance), which is what
+  lets two sides of a split certify CONFLICTING blocks instead of
+  accidentally agreeing on identical empty ones.
 
-Every generated scenario heals before the end, so the checker judges
-BOTH properties: safety across the whole run (the twin pair is the
-byzantine fault — honest nodes must never commit conflicting blocks no
-matter which twin they heard) and post-heal liveness.
+The per-round controls make the Twins paper's boundary executable:
+``dual_commit_config(pairs=2)`` scripts two twinned seats at n=4 —
+faults strictly beyond the f=1 tolerance — into a split where BOTH
+sides hold a full quorum of distinct seats, each side chains its own
+QCs over salted twin proposals, and two honest nodes commit conflicting
+blocks (the checker's safety verdict flags it). The same script with
+``pairs=1`` (faults within tolerance) leaves one side short of quorum:
+safety provably holds. ``tests/test_sim_twins.py`` pins both sides of
+that boundary.
+
+Every time-windowed scenario (``enumerate_twins`` / ``twins_scenario``)
+heals before the end, so the checker judges BOTH properties: safety
+across the whole run (the twin pair is the byzantine fault — honest
+nodes must never commit conflicting blocks no matter which twin they
+heard) and post-heal liveness.
 
 ``enumerate_twins`` is exhaustive over (twin seat × partition
 arrangement × window phase) below the cap; ``twins_scenario`` draws one
@@ -40,7 +60,14 @@ from hotstuff_tpu.faultline.policy import Scenario, _seed_stream
 
 from .world import SimWorld, _node_name
 
-__all__ = ["TWIN_SUFFIX", "enumerate_twins", "run_twins", "twins_scenario"]
+__all__ = [
+    "TWIN_SUFFIX",
+    "dual_commit_config",
+    "enumerate_twins",
+    "run_twins",
+    "twins_round_scenario",
+    "twins_scenario",
+]
 
 TWIN_SUFFIX = "+twin"
 
@@ -149,6 +176,100 @@ def twins_scenario(seed: int, n: int = 4, *, duration_s: float = 8.0):
         name=f"twins-seed{seed}", seed=seed, duration_s=duration_s, events=events
     )
     return scenario, {_twin_name(twin): twin}
+
+
+def dual_commit_config(n: int = 4, *, pairs: int = 2, rounds: int = 60):
+    """The Twins tolerance boundary as an executable config: returns
+    ``(scenario, twins_map, sim_kwargs)`` for :func:`run_twins`.
+
+    With ``pairs=2`` at ``n=4`` (two twinned seats — faults strictly
+    beyond the f=1 tolerance) the script separates the copies into two
+    sides that EACH hold a quorum of distinct seats::
+
+        side A: n000,  n001,  n002        side B: n000', n001', n003
+
+    Every scripted round pins a twinned seat as leader, so both of its
+    copies believe they lead and propose to their own side; the
+    per-instance proposal salt makes those same-round blocks conflict
+    by digest, each side certifies and 2-chains its own blocks, and the
+    two honest observers (n002, n003) commit CONFLICTING blocks — the
+    checker's safety verdict must flag it.
+
+    With ``pairs=1`` (within tolerance) side B is one distinct seat
+    short of quorum: it can never certify anything, so safety provably
+    holds no matter the schedule — the unreachable side of the
+    boundary, pinned by the same test that pins the violation.
+    """
+    if n != 4:
+        raise ValueError("the scripted boundary is a committee-of-4 story")
+    if pairs not in (1, 2):
+        raise ValueError("pairs must be 1 (safe) or 2 (violating)")
+    names = [_node_name(i) for i in range(n)]
+    twinned = names[:pairs]
+    twins_map = {_twin_name(b): b for b in twinned}
+    side_a = sorted(names[:3])
+    side_b = sorted([_twin_name(b) for b in twinned] + names[3:])
+    # Leaders alternate over the twinned seats only: every scripted
+    # round both sides have a copy of the leader, so neither waits on
+    # rotation reaching an absent seat.
+    leader_schedule = {r: twinned[r % len(twinned)] for r in range(rounds)}
+    round_partitions = {r: [side_a, side_b] for r in range(rounds)}
+    scenario = Scenario(
+        name=f"twins-dual-commit-p{pairs}",
+        seed=0,
+        duration_s=8.0,
+        events=[],
+    )
+    sim_kwargs = {
+        "leader_schedule": leader_schedule,
+        "round_partitions": round_partitions,
+        "twin_proposal_salt": True,
+    }
+    return scenario, twins_map, sim_kwargs
+
+
+def twins_round_scenario(
+    seed: int,
+    n: int = 4,
+    *,
+    rounds: int = 40,
+    duration_s: float = 8.0,
+):
+    """One seed-drawn PER-ROUND Twins configuration — the paper's actual
+    adversary space: each scripted round independently draws a leader
+    (any seat) and a partition arrangement separating the twin pair.
+    Returns ``(scenario, twins_map, sim_kwargs)``; rounds beyond the
+    scripted range are fully connected with round-robin leaders. Safety
+    is judged across the whole run regardless; post-heal liveness is
+    only meaningful for runs that exhaust the scripted range in time —
+    a schedule whose drawn leaders keep landing on the minority side
+    grinds at timeout pace and may end mid-script, which the checker
+    reports as ``recovered: false`` rather than a safety problem."""
+    rng = _seed_stream(seed, "twins-rounds")
+    names = [_node_name(i) for i in range(n)]
+    twin = rng.choice(names)
+    instances = sorted([*names, _twin_name(twin)])
+    arrangements = _partition_arrangements(instances, twin)
+    leader_schedule: dict[int, str] = {}
+    round_partitions: dict[int, list] = {}
+    for r in range(rounds):
+        leader_schedule[r] = rng.choice(names)
+        # ~1 round in 4 left fully connected: progress interleaves with
+        # splits, which is where stale-QC / fork-choice bugs live.
+        if rng.random() < 0.75:
+            round_partitions[r] = rng.choice(arrangements)
+    scenario = Scenario(
+        name=f"twins-rounds-seed{seed}",
+        seed=seed,
+        duration_s=duration_s,
+        events=[],
+    )
+    sim_kwargs = {
+        "leader_schedule": leader_schedule,
+        "round_partitions": round_partitions,
+        "twin_proposal_salt": True,
+    }
+    return scenario, {_twin_name(twin): twin}, sim_kwargs
 
 
 def run_twins(scenario: Scenario, twins_map: dict[str, str], n: int = 4, **kwargs):

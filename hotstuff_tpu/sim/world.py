@@ -218,6 +218,17 @@ class SimWorld:
         # keep byte-identical event streams with it off).
         retention_rounds: int = 0,
         statesync_active: bool = False,
+        # Oracle: a sim.streams.StreamRecorder capturing every node's
+        # round-trace marks on the virtual clock for rendering into
+        # real-shape telemetry streams.
+        recorder=None,
+        # Twins (per-round adversary controls): a {round: seat_name}
+        # leader override, a {round: [group, ...]} network partition
+        # keyed on the SENDER's current round, and per-instance proposal
+        # salting so a twin pair's blocks conflict by digest.
+        leader_schedule: dict[int, str] | None = None,
+        round_partitions: dict[int, list] | None = None,
+        twin_proposal_salt: bool = False,
     ) -> None:
         self.scenario = scenario
         self.n = n
@@ -259,6 +270,30 @@ class SimWorld:
         )
 
         self.clock = VirtualClock()
+        self._recorder = recorder
+        if recorder is not None:
+            recorder.bind(
+                self.clock,
+                {repr(keypairs[name][0]): name for name in base_names},
+            )
+        self._twin_salt = bool(twin_proposal_salt)
+        self._round_partitions = None
+        if round_partitions:
+            self._round_partitions = {
+                int(r): [frozenset(g) for g in groups]
+                for r, groups in round_partitions.items()
+            }
+        self._elector_override = None
+        if leader_schedule is not None:
+            from hotstuff_tpu.consensus.leader import ScheduledLeaderElector
+
+            self._elector_override = ScheduledLeaderElector(
+                self.committee,
+                {
+                    int(r): keypairs[name][0]
+                    for r, name in leader_schedule.items()
+                },
+            )
         self.plane = FaultPlane(
             self.schedule,
             {addresses[name]: name for name in base_names},
@@ -330,7 +365,19 @@ class SimWorld:
         """Route one unframed wire message through the fault plane to
         every instance listening on ``address``."""
         now = self.clock.now
+        rp = self._round_partitions
+        if rp is not None and src_slot.machine is not None:
+            # Twins per-round partition: connectivity for a message is
+            # decided by the SENDER's current round. Rounds without an
+            # assignment are fully connected.
+            groups = rp.get(src_slot.machine.round)
+        else:
+            groups = None
         for dst_slot in self._by_addr.get(address, ()):
+            if groups is not None and not any(
+                src_slot.name in g and dst_slot.name in g for g in groups
+            ):
+                continue
             plan = self.plane.filter_send(
                 address, data, payload_off=0,
                 src=src_slot.name, dst=dst_slot.name,
@@ -404,11 +451,32 @@ class SimWorld:
         slot.machine = machine
         slot.crashed = False
         slot.timer_target = None
+        if self._elector_override is not None:
+            # Per-round Twins control: every instance (twins included)
+            # consults the same fixed schedule. Stateless, so shared.
+            machine.core.leader_elector = self._elector_override
+        if self._twin_salt:
+            machine.proposal_salt = slot.name.encode()
+            # Every instance must treat every other instance's salted
+            # payload digest as available (see _SimMempoolDriver): the
+            # Twins model assumes universal batch availability; digest
+            # divergence — not data withholding — is what's under test.
+            machine.mempool_driver.twin_salts = tuple(
+                s.name.encode() for s in self.slots
+            )
+        if self._recorder is not None:
+            # Splice the virtual-clock trace in BEFORE init() so the
+            # restored-round proposal of a restarting leader is on tape.
+            self._recorder.attach(slot)
         self._apply_effects(slot, machine.init(self.clock.now))
 
     def _crash(self, slot: _Slot) -> None:
         if slot.crashed or slot.machine is None:
             return
+        if self._recorder is not None:
+            # SIGKILL semantics: close the writer epoch; events past the
+            # last emit boundary die with it at render time.
+            self._recorder.crashed(slot.name)
         slot.machine = None
         slot.crashed = True
         slot.incarnation += 1  # drops every in-flight frame/event/timer
@@ -483,6 +551,8 @@ class SimWorld:
             if self._recovered:
                 break
 
+        if self._recorder is not None:
+            self._recorder.finish()
         verdict = check(
             self.schedule,
             self.commits,

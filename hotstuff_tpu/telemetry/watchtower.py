@@ -100,6 +100,13 @@ DETECTORS = (
     "equivocation",
 )
 
+#: Version of the detector catalog above (bump whenever a detector is
+#: added, removed, or its evidence/confidence semantics change). Stamped
+#: into every alert and every detector_bench/detector_sweep verdict next
+#: to the config fingerprint, so scorecards are comparable across runs:
+#: same (catalog, config hash) ⇒ same detection semantics.
+DETECTOR_CATALOG_VERSION = 1
+
 #: trace stages that constitute peer-behavior evidence. Anything else in
 #: the ring (faultline injection audit events, future stages) must not
 #: mint phantom peers or skew scores — observed live: the "faultline"
@@ -137,6 +144,10 @@ def validate_alert_record(obj) -> list[str]:
         problems.append("ts missing or not a number")
     if not isinstance(obj.get("evidence"), dict):
         problems.append("evidence missing or not an object")
+    if not isinstance(obj.get("config"), str) or not obj.get("config"):
+        problems.append("config fingerprint missing or not a string")
+    if not isinstance(obj.get("catalog"), int):
+        problems.append("catalog version missing or not an int")
     window = obj.get("window")
     if not isinstance(window, dict) or not all(
         isinstance(window.get(k), (int, float)) for k in ("t_lo", "t_hi")
@@ -164,6 +175,16 @@ class WatchtowerConfig:
     #: misbehavior (observed live: three of four healthy soak nodes
     #: accused as laggards).
     settle_s: float = 1.0
+    #: the emit-interval multiple a round must have settled for before a
+    #: window will judge it (effective settle = max(settle_s,
+    #: settle_multiplier × largest declared emit interval)). Was a
+    #: hard-coded 1.2; the detector_sweep searches it.
+    settle_multiplier: float = 1.2
+    #: alerts below this confidence are suppressed at the source (0.0 =
+    #: keep everything). The low-confidence branches (partition
+    #: global_stall at 0.5, grinding no_proposals at 0.6) are the main
+    #: false-alarm producers on short incidents; the sweep tunes this.
+    alert_min_confidence: float = 0.0
     #: windows with fewer vote-active rounds than this are not judged.
     min_rounds: int = 4
     silent_participation_max: float = 0.10
@@ -179,6 +200,17 @@ class WatchtowerConfig:
     laggard_stale_s: float = 12.0
     grind_timeout_rate: float = 0.25
     grind_min_proposals: int = 2
+    #: how long a peer must have gone WITHOUT any observed proposal
+    #: before the "alive but never proposing" grinding mode may accuse
+    #: it. A single evidence window during a timeout grind spans only a
+    #: couple of rounds — far less than one leader rotation — so
+    #: "didn't propose in-window" alone is the dominant wrong-peer
+    #: attribution in the offline sweep: rotation simply never reached
+    #: the accused. Cross-window proposal staleness discriminates: even
+    #: mid-grind an honest peer proposes every rotation (~committee
+    #: size seconds), while the silent leader stays stale for its whole
+    #: fault. 0 keeps the legacy gate (in-window evidence only).
+    grind_proposal_stale_s: float = 0.0
     rss_growth_max_bytes_per_s: float = 8 * 1024 * 1024
     store_growth_max_bytes_per_s: float = 32 * 1024 * 1024
     slope_window_s: float = 10.0
@@ -207,6 +239,33 @@ class WatchtowerConfig:
         if unknown:
             raise ValueError(f"unknown watchtower config keys: {sorted(unknown)}")
         return cls(**d)
+
+    def fingerprint(self) -> str:
+        """Short content hash of every knob — the ``config`` field every
+        alert and sweep verdict carries. Field defaults count: adding a
+        knob changes the fingerprint of the default config, which is the
+        point (the detection surface changed)."""
+        import hashlib
+
+        payload = json.dumps(
+            {k: getattr(self, k) for k in sorted(self.__dataclass_fields__)},
+            sort_keys=True,
+            separators=(",", ":"),
+        )
+        return hashlib.sha256(payload.encode()).hexdigest()[:12]
+
+    @classmethod
+    def preset(cls, name: str) -> "WatchtowerConfig":
+        """Load a committed preset from ``telemetry/presets/<name>.json``
+        (e.g. ``tuned-n4``, produced by ``benchmark.detector_sweep``)."""
+        path = os.path.join(
+            os.path.dirname(os.path.abspath(__file__)),
+            "presets",
+            f"{name}.json",
+        )
+        with open(path) as f:
+            doc = json.load(f)
+        return cls.from_dict(doc["config"] if "config" in doc else doc)
 
 
 class _Round:
@@ -286,6 +345,7 @@ class Watchtower:
         label: str = "",
     ) -> None:
         self.config = config or WatchtowerConfig()
+        self._config_hash = self.config.fingerprint()
         self.alias = dict(alias or {})
         self.on_alert = on_alert
         self.label = label
@@ -304,6 +364,15 @@ class Watchtower:
         self._peers: set[str] = set()
         self._heights: dict[str, int] = {}
         self._last_commit_seen: dict[str, float] = {}
+        # Anchored at first sight like _last_commit_seen: staleness is
+        # "silent since we started watching", never "since epoch".
+        self._last_proposal_seen: dict[str, float] = {}
+        # Last wall time one of the peer's proposed rounds was seen to
+        # commit — healthy-leadership evidence for the grinding
+        # detector's uncommitted_proposals mode (a proposal's commit
+        # lands ~2 rounds later; judging a single window accuses honest
+        # leaders for ordinary 2-chain commit lag under timeouts).
+        self._last_proposal_commit_seen: dict[str, float] = {}
         self._max_interval = 0.0  # largest emit interval any meta declares
         self._prev_heights: dict[str, int] = {}
         self._prev_frontier = 0
@@ -375,6 +444,8 @@ class Watchtower:
         if node not in self._peers:
             self._peers.add(node)
             self._last_commit_seen.setdefault(node, t)
+            self._last_proposal_seen.setdefault(node, t)
+            self._last_proposal_commit_seen.setdefault(node, t)
         self._last_seen[node] = t
         if round_ > self._max_round_seen:
             self._max_round_seen = round_
@@ -391,6 +462,8 @@ class Watchtower:
             if author not in self._peers:
                 self._peers.add(author)
                 self._last_commit_seen.setdefault(author, t)
+                self._last_proposal_seen.setdefault(author, t)
+                self._last_proposal_commit_seen.setdefault(author, t)
             self._last_seen[author] = max(self._last_seen.get(author, 0), t)
             seen = rd.votes.setdefault(author, set())
             if digest not in seen and seen:
@@ -417,6 +490,18 @@ class Watchtower:
             author = None
         if stage in ("propose", "propose_send") and author is not None:
             self._peers.add(author)
+            # Exoneration evidence must come from ANOTHER node's stream:
+            # a silent leader's own telemetry still self-reports
+            # propose_send (it builds and "sends"; the network eats it),
+            # and a byzantine node can claim anything about itself. Only
+            # a proposal some other node actually RECEIVED proves the
+            # peer proposed.
+            if (
+                stage == "propose"
+                and author != node
+                and t > self._last_proposal_seen.get(author, 0)
+            ):
+                self._last_proposal_seen[author] = t
             seen = rd.proposes.setdefault(author, set())
             if digest not in seen and seen:
                 fired += self._alert(
@@ -644,6 +729,26 @@ class Watchtower:
             self._now = now
         return self._maybe_close()
 
+    def feed(self, records, now: float | None = None) -> list[dict]:
+        """Batch ingestion: drive a whole stream (or a merged timeline)
+        through the tower in one call — no tail-follower, no sleeps.
+        ``records`` yields parsed stream objects, or ``(obj, source)``
+        pairs when per-source anchor keying matters (multi-stream
+        replay). Windows close inline as the observed wall clock
+        advances, exactly as they would under a live follower; a final
+        ``tick(now)`` (``now=None`` → the newest observed wall time)
+        judges anything due. Replaying a full schedule is milliseconds —
+        this is Oracle's inner loop (``benchmark.detector_sweep``)."""
+        fired: list[dict] = []
+        for rec in records:
+            if isinstance(rec, tuple):
+                obj, source = rec
+            else:
+                obj, source = rec, ""
+            fired.extend(self.ingest_record(obj, source))
+        fired.extend(self.tick(now))
+        return fired
+
     def flush(self) -> list[dict]:
         """End of stream: close every pending round and judge."""
         return self._maybe_close(force=True)
@@ -651,7 +756,10 @@ class Watchtower:
     def _effective_settle(self) -> float:
         # Streams flush in emit-interval bursts: a round is only fully
         # observable once every stream's burst covering it landed.
-        return max(self.config.settle_s, 1.2 * self._max_interval)
+        return max(
+            self.config.settle_s,
+            self.config.settle_multiplier * self._max_interval,
+        )
 
     def _maybe_close(self, force: bool = False) -> list[dict]:
         cfg = self.config
@@ -691,6 +799,10 @@ class Watchtower:
                 win.active_peers.add(author)
                 if rd.commit_nodes:
                     win.proposals_committed[author] += 1
+                    if rd.last_wall > self._last_proposal_commit_seen.get(
+                        author, 0
+                    ):
+                        self._last_proposal_commit_seen[author] = rd.last_wall
                 for receiver in rd.propose_t:
                     win.edges.add(frozenset((author, receiver)))
             for leader in rd.propose_senders:
@@ -700,6 +812,10 @@ class Watchtower:
                     win.proposals_committed[leader] = max(
                         win.proposals_committed[leader], 1
                     )
+                    if rd.last_wall > self._last_proposal_commit_seen.get(
+                        leader, 0
+                    ):
+                        self._last_proposal_commit_seen[leader] = rd.last_wall
             for node, n in rd.timeouts.items():
                 win.timeouts[node] += n
                 win.active_peers.add(node)
@@ -812,10 +928,14 @@ class Watchtower:
         if n_rounds >= cfg.min_rounds and timeout_rate >= cfg.grind_timeout_rate:
             committed_any = sum(win.proposals_committed.values()) > 0
             for p, n in sorted(win.proposals.items()):
+                leadership_stale_s = self._now - (
+                    self._last_proposal_commit_seen.get(p, 0.0)
+                )
                 if (
                     n >= cfg.grind_min_proposals
                     and win.proposals_committed.get(p, 0) == 0
                     and committed_any
+                    and leadership_stale_s >= cfg.grind_proposal_stale_s
                 ):
                     fired += self._alert(
                         "grinding_leader",
@@ -825,6 +945,7 @@ class Watchtower:
                         {"mode": "uncommitted_proposals",
                          "proposals": n,
                          "committed": 0,
+                         "leadership_stale_s": round(leadership_stale_s, 1),
                          "timeout_rate": round(timeout_rate, 3)},
                         window=window,
                         rounds=rounds_span,
@@ -836,7 +957,12 @@ class Watchtower:
                     # while the committee burns timeouts: the silent
                     # leader shape. Needs the peer visibly alive — a
                     # crashed peer is the laggard/silent detectors' job.
-                    if win.voted_rounds.get(p, 0) or win.timeouts.get(p, 0):
+                    proposal_stale_s = self._now - self._last_proposal_seen.get(
+                        p, 0.0
+                    )
+                    if (
+                        win.voted_rounds.get(p, 0) or win.timeouts.get(p, 0)
+                    ) and proposal_stale_s >= cfg.grind_proposal_stale_s:
                         fired += self._alert(
                             "grinding_leader",
                             [p],
@@ -844,6 +970,7 @@ class Watchtower:
                             t,
                             {"mode": "no_proposals",
                              "proposing_peers": sorted(proposers),
+                             "proposal_stale_s": round(proposal_stale_s, 1),
                              "timeout_rate": round(timeout_rate, 3)},
                             window=window,
                             rounds=rounds_span,
@@ -939,6 +1066,10 @@ class Watchtower:
         window: tuple[float, float],
         rounds: list[int] | None = None,
     ) -> list[dict]:
+        if confidence < self.config.alert_min_confidence:
+            # Suppressed at the source (cooldown untouched: a later
+            # higher-confidence accusation must not find itself muted).
+            return []
         accused = [self.alias.get(a, a) for a in accused]
         key = (detector, tuple(sorted(accused)))
         last = self._last_alert_at.get(key)
@@ -953,6 +1084,8 @@ class Watchtower:
             "confidence": round(float(confidence), 3),
             "ts": t,
             "node": self.label,
+            "config": self._config_hash,
+            "catalog": DETECTOR_CATALOG_VERSION,
             "window": {
                 "t_lo": window[0],
                 "t_hi": window[1],

@@ -1,0 +1,151 @@
+"""Oracle's scoring loop: labeled schedules → streams → Watchtower →
+scorecard. Pins the properties CI leans on — deterministic scoring,
+honest incident labeling, and a committed tuned preset that round-trips
+to the exact config hash the scorecard stamped.
+"""
+
+import json
+import os
+
+import pytest
+
+from benchmark.detector_sweep import (
+    MATCH_LEAD_S,
+    MATCH_SLACK_S,
+    PINNED_CLASSES,
+    ScoreAccumulator,
+    control_scenario,
+    match_alerts,
+    replay_config,
+    run_schedule,
+    single_fault_scenario,
+)
+from hotstuff_tpu.faultline.policy import chaos_scenario
+from hotstuff_tpu.telemetry.watchtower import (
+    DETECTOR_CATALOG_VERSION,
+    WatchtowerConfig,
+)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+SCORECARD = os.path.join(REPO, "results", "detector-scorecard-n4.json")
+PRESET = os.path.join(
+    REPO, "hotstuff_tpu", "telemetry", "presets", "tuned-n4.json"
+)
+
+
+def _score(config, specs):
+    acc = ScoreAccumulator()
+    for tag, is_control, scenario in specs:
+        timeline, incidents, _ = run_schedule(scenario)
+        alerts = replay_config(timeline, config)
+        match_alerts(incidents, alerts)
+        acc.add(tag, incidents, alerts, control=is_control)
+    return acc
+
+
+def _small_specs():
+    specs = []
+    for kind in ("crash", "byzantine:equivocate"):
+        specs.append((f"single:{kind}:0", False, single_fault_scenario(kind, 0)))
+    specs.append(("control:0", True, control_scenario(0)))
+    return specs
+
+
+def test_scoring_is_deterministic():
+    """Same corpus, same config → identical report dict, twice. The
+    committed scorecard's numbers are only meaningful if re-running the
+    sweep cannot wobble them."""
+    cfg = WatchtowerConfig()
+    a = _score(cfg, _small_specs()).report()
+    b = _score(cfg, _small_specs()).report()
+    assert json.dumps(a, sort_keys=True) == json.dumps(b, sort_keys=True)
+
+
+def test_single_fault_scenarios_are_pinned_and_isolated():
+    """Every single-fault schedule must contribute exactly one pinned
+    incident of its class — the recall-floor denominators CI gates on."""
+    for kind in PINNED_CLASSES:
+        scenario = single_fault_scenario(kind, 1)
+        _, incidents, _ = run_schedule(scenario)
+        pinned = [i for i in incidents if i.get("pinned")]
+        assert len(pinned) == 1, (kind, incidents)
+        assert pinned[0]["class"] == kind
+        assert pinned[0]["until"] - pinned[0]["t"] >= 5.0
+
+
+def test_match_window_attributes_alerts_to_incidents():
+    """An alert matches an incident iff it accuses the victim with an
+    expected detector inside [t - lead, until + slack] — pin the
+    window edges so a silent widening can't inflate recall."""
+    incidents = [{
+        "class": "crash", "kind": "crash", "peer": "n001",
+        "t": 10.0, "until": 17.0, "duration_s": 7.0, "pinned": True,
+    }]
+    inside = {
+        "detector": "silent_voter", "accused": ["n001"],
+        "ts": 10.0 - MATCH_LEAD_S, "confidence": 0.9,
+    }
+    outside = dict(inside, ts=17.0 + MATCH_SLACK_S + 0.1)
+    wrong_peer = dict(inside, accused=["n002"])
+    alerts = [dict(inside), dict(outside), dict(wrong_peer)]
+    match_alerts(incidents, alerts)
+    assert incidents[0]["detected"]
+    assert alerts[0]["matched"]
+    assert not alerts[1]["matched"]
+    assert not alerts[2]["matched"]
+
+
+def test_control_alerts_count_as_false_alarms():
+    acc = ScoreAccumulator()
+    acc.add("control:x", [], [
+        {"detector": "laggard", "accused": ["n000"], "ts": 3.0,
+         "confidence": 0.8, "matched": False},
+    ], control=True)
+    assert acc.control_alerts == 1
+    assert not acc.feasible()
+
+
+def test_chaos_schedule_yields_labeled_incidents():
+    _, incidents, _ = run_schedule(chaos_scenario(seed=0, duration_s=11.0))
+    assert len(incidents) >= 4
+    kinds = {i["class"] for i in incidents}
+    assert any(k.startswith("byzantine") for k in kinds)
+
+
+@pytest.mark.skipif(
+    not (os.path.exists(PRESET) and os.path.exists(SCORECARD)),
+    reason="tuned preset / scorecard not committed yet",
+)
+def test_tuned_preset_round_trips_to_committed_hash():
+    """`WatchtowerConfig.preset('tuned-n4')` must reconstruct exactly
+    the config the sweep scored: fingerprint == the preset's own
+    config_hash == the scorecard's tuned config_hash, at the same
+    detector-catalog version."""
+    cfg = WatchtowerConfig.preset("tuned-n4")
+    with open(PRESET) as f:
+        preset_doc = json.load(f)
+    assert cfg.fingerprint() == preset_doc["config_hash"]
+    assert preset_doc["detector_catalog"] == DETECTOR_CATALOG_VERSION
+    with open(SCORECARD) as f:
+        scorecard = json.load(f)
+    assert scorecard["tuned"]["config_hash"] == preset_doc["config_hash"]
+    assert scorecard["detector_catalog"] == DETECTOR_CATALOG_VERSION
+
+
+@pytest.mark.skipif(
+    not os.path.exists(SCORECARD),
+    reason="scorecard not committed yet",
+)
+def test_committed_scorecard_meets_the_gate():
+    """The committed numbers ARE the acceptance claim: tuned recall
+    1.0 on pinned classes, zero control alerts, precision strictly
+    above the default config's."""
+    with open(SCORECARD) as f:
+        scorecard = json.load(f)
+    gate = scorecard["gate"]
+    assert gate["ok"], gate
+    assert gate["recall_pinned"] == 1.0
+    assert gate["control_alerts"] == 0
+    tuned_p, default_p = gate["precision_vs_default"]
+    assert tuned_p > default_p
+    assert scorecard["tuned"]["incidents"] >= 2000

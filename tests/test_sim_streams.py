@@ -1,0 +1,218 @@
+"""Oracle's sim→stream bridge: Simulant runs rendered as the exact
+telemetry streams the real emitters write, replayed through Watchtower
+on the virtual clock.
+
+Three contracts pinned here:
+
+- rendering is byte-deterministic in the scenario (the sweep's cache
+  and CI reproducibility both stand on it);
+- the rendered files ARE the wire format — ``telemetry.validate``
+  accepts them and a restart shows up as a real meta boundary with a
+  new pid, a crash as a lost unflushed tail;
+- per-detector ground truth: for every stream detector there is a
+  labeled fixture it fires on (accusing the actual victim) and a
+  near-miss negative it must stay quiet on.
+"""
+
+import json
+
+from benchmark.detector_sweep import (
+    control_scenario,
+    single_fault_scenario,
+)
+from hotstuff_tpu.faultline.policy import Scenario
+from hotstuff_tpu.sim.streams import StreamRecorder, replay_watchtower
+from hotstuff_tpu.sim.world import SimWorld
+from hotstuff_tpu.telemetry.emitter import META_SCHEMA, SCHEMA
+from hotstuff_tpu.telemetry.validate import validate_stream
+from hotstuff_tpu.telemetry.watchtower import WatchtowerConfig
+
+# Small-window tuning in the spirit of the committed tuned preset:
+# 7-second faults inside 13-second runs are invisible to the default
+# 5-second windows, so the per-detector fixtures use the geometry the
+# sweep converges to.
+SMALL = dict(
+    window_s=2.0,
+    window_rounds=12,
+    min_rounds=3,
+    settle_s=1.0,
+    laggard_windows=1,
+    laggard_min_lag=6,
+    laggard_stale_s=4.0,
+    silent_windows=1,
+)
+
+
+def _record(scenario, interval_s=0.5):
+    rec = StreamRecorder(interval_s=interval_s)
+    result = SimWorld(scenario, 4, recorder=rec).run()
+    return rec, result
+
+
+def _alerts(scenario, config=None):
+    rec, _ = _record(scenario)
+    _, alerts = replay_watchtower(rec, config)
+    return alerts
+
+
+def _victim(scenario):
+    """The single fault's victim as a seat name (the generator's int
+    template resolves modulo committee, same as Scenario.compile)."""
+    nodes = [f"n{i:03d}" for i in range(4)]
+    for ev in scenario.events:
+        if "node" in ev:
+            return nodes[ev["node"] % 4]
+    raise AssertionError("scenario has no node-targeted event")
+
+
+def test_render_is_byte_deterministic():
+    """Identical runs must render byte-identical streams — including a
+    crash/restart epoch boundary, the part where buffered-writer loss
+    could plausibly wobble."""
+    scenario = single_fault_scenario("crash", 0)
+    a = _record(scenario)[0].render()
+    b = _record(scenario)[0].render()
+    assert list(a) == list(b)
+    for name in a:
+        assert a[name] == b[name], f"stream {name} diverged between runs"
+    joined = "\n".join("\n".join(lines) for lines in a.values())
+    assert joined == "\n".join("\n".join(lines) for lines in b.values())
+
+
+def test_written_streams_pass_telemetry_validate(tmp_path):
+    """The bridge writes the real wire format: ``telemetry.validate``
+    must accept every per-node file, self-described by a meta record."""
+    rec, _ = _record(single_fault_scenario("crash", 0))
+    paths = rec.write(str(tmp_path))
+    assert len(paths) == 4
+    for path in paths:
+        report = validate_stream(path)
+        assert report["ok"], report["problems"]
+        assert report["self_described"]
+        assert report["counts"][SCHEMA] > 0
+
+
+def test_restart_opens_new_epoch_with_new_pid():
+    """A crash+restart is a writer death and a new process: the
+    victim's stream must carry TWO meta records with distinct pids —
+    the mid-stream boundary Watchtower's anchor tracking keys on."""
+    scenario = single_fault_scenario("crash", 0)
+    victim = _victim(scenario)
+    rec, _ = _record(scenario)
+    lines = rec.render()[victim]
+    metas = [
+        json.loads(line)
+        for line in lines
+        if json.loads(line)["schema"] == META_SCHEMA
+    ]
+    assert len(metas) == 2
+    assert metas[0]["pid"] != metas[1]["pid"]
+    assert metas[1]["ts"] > metas[0]["ts"]
+
+
+def test_crash_loses_the_unflushed_tail():
+    """A SIGKILL never flushes: the crashed epoch must end WITHOUT a
+    ``final: true`` snapshot and without events past its last emit
+    boundary, while cleanly-shut-down nodes do flush one."""
+    scenario = Scenario(
+        name="streams-crash-tail",
+        seed=3,
+        duration_s=8.0,
+        events=[{"kind": "crash", "node": 1, "at": 4.0}],
+    )
+    rec, _ = _record(scenario)
+    streams = {
+        name: [json.loads(line) for line in lines]
+        for name, lines in rec.render().items()
+    }
+    victim_finals = [
+        r for r in streams["n001"]
+        if r["schema"] == SCHEMA and r.get("final")
+    ]
+    assert victim_finals == [], "crashed writer must not flush a final"
+    for r in streams["n001"]:
+        if "ts" in r:
+            assert r["ts"] <= 4.0
+        for ev in r.get("events", ()):
+            assert ev[4] <= 4.0, "event past the last durable boundary"
+    for survivor in ("n000", "n002", "n003"):
+        finals = [
+            r for r in streams[survivor]
+            if r["schema"] == SCHEMA and r.get("final")
+        ]
+        assert len(finals) == 1
+
+
+def test_detector_equivocation_fires_on_equivocating_victim():
+    scenario = single_fault_scenario("byzantine:equivocate", 0)
+    victim = _victim(scenario)
+    alerts = _alerts(scenario)
+    assert any(
+        a["detector"] == "equivocation" and a["accused"] == [victim]
+        for a in alerts
+    ), alerts
+
+
+def test_detector_grinding_leader_fires_on_silent_leader():
+    scenario = single_fault_scenario("byzantine:silent_leader", 15)
+    victim = _victim(scenario)
+    alerts = _alerts(scenario)
+    assert any(
+        a["detector"] == "grinding_leader" and a["accused"] == [victim]
+        for a in alerts
+    ), alerts
+
+
+def test_detector_laggard_fires_on_crashed_node():
+    scenario = single_fault_scenario("crash", 2)
+    victim = _victim(scenario)
+    alerts = _alerts(scenario, WatchtowerConfig(**SMALL))
+    assert any(
+        a["detector"] == "laggard" and a["accused"] == [victim]
+        for a in alerts
+    ), alerts
+
+
+def test_detector_partitioned_clique_fires_on_partition_victim():
+    scenario = single_fault_scenario("partition", 2)
+    alerts = _alerts(scenario, WatchtowerConfig(**SMALL))
+    assert any(a["detector"] == "partitioned_clique" for a in alerts), alerts
+
+
+def test_detector_silent_voter_fires_on_partition_victim():
+    scenario = single_fault_scenario("partition", 3)
+    alerts = _alerts(scenario, WatchtowerConfig(**SMALL))
+    assert any(a["detector"] == "silent_voter" for a in alerts), alerts
+
+
+def test_near_miss_negatives_stay_quiet():
+    """The other half of ground truth: a fault-free run, a sub-window
+    partition blip, and a crash moments before scenario end all look
+    ALMOST like incidents — none may alert (these are the shapes that
+    keep the sweep's false-alarm gate honest)."""
+    assert _alerts(control_scenario(0)) == []
+    blip = Scenario(
+        name="near-miss-partition",
+        seed=9,
+        duration_s=8.0,
+        events=[{"kind": "partition", "at": 3.0, "until": 3.8}],
+    )
+    assert _alerts(blip) == []
+    late = Scenario(
+        name="near-miss-late-crash",
+        seed=9,
+        duration_s=8.0,
+        events=[{"kind": "crash", "node": 1, "at": 7.4}],
+    )
+    assert _alerts(late) == []
+
+
+def test_alert_timestamps_are_virtual_seconds():
+    """Alert ``ts`` must land in the schedule's virtual timeline (the
+    whole point of the zero anchor): accusations about a fault at
+    t≈2s in a ~13s run may not carry wall-epoch timestamps."""
+    scenario = single_fault_scenario("byzantine:equivocate", 0)
+    alerts = _alerts(scenario)
+    assert alerts
+    for a in alerts:
+        assert 0.0 <= a["ts"] <= scenario.duration_s + 10.0
